@@ -1,0 +1,19 @@
+"""raft_tpu — a TPU-native (JAX/XLA) frequency-domain dynamics framework for
+floating offshore wind turbines, with the capability surface of dzalkind/RAFT.
+
+Everything between "design parameters" and "response statistics" is a pure,
+jittable, vmappable, differentiable function; host-side preprocessing (YAML
+parsing, meshing, BEM coefficient generation) emits device arrays.
+"""
+
+__version__ = "0.1.0"
+
+
+def enable_x64():
+    """Enable float64 globally (recommended for CPU validation runs)."""
+    import jax
+
+    jax.config.update("jax_enable_x64", True)
+
+
+from raft_tpu.core.types import Env, HydroCoeffs, MemberSet, RigidBodyCoeffs, WaveState  # noqa: F401,E402

@@ -1,0 +1,14 @@
+"""Physical constants and environment defaults.
+
+Mirrors the capability of the reference environment container
+(``raft/raft.py:22-30`` in dzalkind/RAFT): seawater density, gravity, and
+default sea-state / wind parameters.
+"""
+
+RHO_SEAWATER = 1025.0   # [kg/m^3] default water density
+GRAVITY = 9.81          # [m/s^2]  gravitational acceleration
+
+DEFAULT_HS = 1.0        # [m]   significant wave height
+DEFAULT_TP = 10.0       # [s]   peak spectral period
+DEFAULT_V = 10.0        # [m/s] mean wind speed
+DEFAULT_BETA = 0.0      # [rad] wave heading

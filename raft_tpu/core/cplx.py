@@ -1,0 +1,181 @@
+"""Complex arithmetic as explicit (re, im) real-array pairs.
+
+The TPU backend in this environment implements no complex dtypes (every
+complex op, even ``complex add``, is UNIMPLEMENTED at the XLA level).  All
+frequency-domain quantities in raft_tpu — wave kinematics, excitation
+amplitudes, impedance matrices, response amplitudes — are therefore carried
+as a :class:`Cx` pytree of two real arrays.  This is also the faster design
+on TPU hardware that *does* support complex: elementwise re/im ops fuse
+freely, and complex matmuls lower to real MXU matmuls.
+
+``Cx`` is a registered pytree (flax.struct), so it passes transparently
+through jit / vmap / grad / scan / shard_map.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+Array = jnp.ndarray
+
+
+@struct.dataclass
+class Cx:
+    """A complex tensor as a (re, im) pair of equally-shaped real arrays."""
+
+    re: Array
+    im: Array
+
+    # ---- constructors ----
+    @staticmethod
+    def of(z) -> "Cx":
+        """From a numpy/jnp complex (or real) array — host-side staging."""
+        z = jnp.asarray(z)
+        return Cx(jnp.real(z), jnp.imag(z) if jnp.iscomplexobj(z) else jnp.zeros_like(jnp.real(z)))
+
+    @staticmethod
+    def zeros(shape, dtype=jnp.float32) -> "Cx":
+        return Cx(jnp.zeros(shape, dtype), jnp.zeros(shape, dtype))
+
+    @staticmethod
+    def expi(theta: Array) -> "Cx":
+        """e^{i theta} for real theta."""
+        return Cx(jnp.cos(theta), jnp.sin(theta))
+
+    # ---- views ----
+    @property
+    def shape(self):
+        return self.re.shape
+
+    @property
+    def dtype(self):
+        return self.re.dtype
+
+    def to_complex(self) -> Array:
+        """Materialize as a jnp complex array (CPU/host use only)."""
+        return self.re + 1j * self.im
+
+    # ---- arithmetic ----
+    def __add__(self, o):
+        if isinstance(o, Cx):
+            return Cx(self.re + o.re, self.im + o.im)
+        return Cx(self.re + o, self.im + jnp.zeros_like(self.im))
+
+    __radd__ = __add__
+
+    def __sub__(self, o):
+        if isinstance(o, Cx):
+            return Cx(self.re - o.re, self.im - o.im)
+        return Cx(self.re - o, self.im)
+
+    def __rsub__(self, o):
+        return Cx(o - self.re, -self.im)
+
+    def __neg__(self):
+        return Cx(-self.re, -self.im)
+
+    def __mul__(self, o):
+        if isinstance(o, Cx):
+            return Cx(self.re * o.re - self.im * o.im, self.re * o.im + self.im * o.re)
+        return Cx(self.re * o, self.im * o)  # o real scalar/array
+
+    __rmul__ = __mul__
+
+    def __truediv__(self, o):
+        # division by zero propagates inf/NaN like numpy complex would;
+        # solver kernels that divide by possibly-padded lanes carry their
+        # own explicit guards instead.
+        if isinstance(o, Cx):
+            d = o.abs2()
+            return Cx(
+                (self.re * o.re + self.im * o.im) / d,
+                (self.im * o.re - self.re * o.im) / d,
+            )
+        return Cx(self.re / o, self.im / o)
+
+    def mul_i(self) -> "Cx":
+        """Multiply by i (e.g. differentiation in frequency domain)."""
+        return Cx(-self.im, self.re)
+
+    def conj(self) -> "Cx":
+        return Cx(self.re, -self.im)
+
+    def abs2(self) -> Array:
+        return self.re * self.re + self.im * self.im
+
+    def abs(self) -> Array:
+        return jnp.sqrt(self.abs2())
+
+    # ---- structural ops (mirror jnp API on both parts) ----
+    def __getitem__(self, idx):
+        return Cx(self.re[idx], self.im[idx])
+
+    def reshape(self, *shape):
+        return Cx(self.re.reshape(*shape), self.im.reshape(*shape))
+
+    def sum(self, axis=None):
+        return Cx(self.re.sum(axis=axis), self.im.sum(axis=axis))
+
+    def swapaxes(self, a, b):
+        return Cx(jnp.swapaxes(self.re, a, b), jnp.swapaxes(self.im, a, b))
+
+    def astype(self, dtype):
+        return Cx(self.re.astype(dtype), self.im.astype(dtype))
+
+
+def where(cond: Array, a: Cx, b: Cx) -> Cx:
+    return Cx(jnp.where(cond, a.re, b.re), jnp.where(cond, a.im, b.im))
+
+
+def stack(xs, axis=0) -> Cx:
+    return Cx(
+        jnp.stack([x.re for x in xs], axis=axis),
+        jnp.stack([x.im for x in xs], axis=axis),
+    )
+
+
+def concatenate(xs, axis=0) -> Cx:
+    return Cx(
+        jnp.concatenate([x.re for x in xs], axis=axis),
+        jnp.concatenate([x.im for x in xs], axis=axis),
+    )
+
+
+def einsum(eq: str, *ops) -> Cx:
+    """einsum over a mix of Cx and real operands (expands re/im products)."""
+    cxs = [isinstance(o, Cx) for o in ops]
+    n_cx = sum(cxs)
+    if n_cx == 0:
+        r = jnp.einsum(eq, *ops)
+        return Cx(r, jnp.zeros_like(r))
+    if n_cx == 1:
+        i = cxs.index(True)
+        re_ops = [o.re if j == i else o for j, o in enumerate(ops)]
+        im_ops = [o.im if j == i else o for j, o in enumerate(ops)]
+        return Cx(jnp.einsum(eq, *re_ops), jnp.einsum(eq, *im_ops))
+    if n_cx == 2:
+        i = cxs.index(True)
+        j = cxs.index(True, i + 1)
+
+        def term(pi, pj):
+            arrs = []
+            for k, o in enumerate(ops):
+                if k == i:
+                    arrs.append(o.re if pi == 0 else o.im)
+                elif k == j:
+                    arrs.append(o.re if pj == 0 else o.im)
+                else:
+                    arrs.append(o)
+            return jnp.einsum(eq, *arrs)
+
+        return Cx(term(0, 0) - term(1, 1), term(0, 1) + term(1, 0))
+    raise NotImplementedError("einsum with >2 complex operands")
+
+
+def matmul(A, B) -> Cx:
+    """Complex matmul via real matmuls (4 real MXU matmuls, or 2 if one is real)."""
+    if isinstance(A, Cx) and isinstance(B, Cx):
+        return Cx(A.re @ B.re - A.im @ B.im, A.re @ B.im + A.im @ B.re)
+    if isinstance(A, Cx):
+        return Cx(A.re @ B, A.im @ B)
+    return Cx(A @ B.re, A @ B.im)

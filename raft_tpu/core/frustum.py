@@ -1,0 +1,105 @@
+"""Frustum volume / centroid / moment-of-inertia kernels.
+
+The reference computes these with per-case closed forms (``FrustumVCV``
+raft/raft.py:873-900, ``FrustumMOI`` raft/raft.py:251-269,
+``RectangularFrustumMOI`` raft/raft.py:271-332 — the latter with four
+branches, one of which is broken upstream).  Here a single vectorized
+implementation covers every case: all the integrands are polynomials of
+degree <= 4 in the axial coordinate (cross-section dimensions vary linearly),
+so a fixed 3-point Gauss-Legendre rule is *exact* — no branches, no special
+cases, fully batch-broadcastable and differentiable.
+
+Conventions: a "section pair" is (dA, dB) with shape (...,2) holding the two
+side lengths of a rectangular section or [d, d] for a circular one; ``circ``
+is a boolean selecting the circular area/inertia formulas.
+
+Deviations from the reference (documented, intentional):
+  * Rectangular frusta whose two side lengths taper non-proportionally use
+    the exact integral here; the reference applies the pyramidal-frustum
+    formula with a geometric-mean mid-area (raft/raft.py:888), which is only
+    exact for proportional taper.
+  * The reference's general rectangular-taper MOI branch raises a TypeError
+    upstream (``H(...)`` called as a function, raft/raft.py:295-298); here it
+    is simply the same quadrature.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+import math
+
+import numpy as np
+
+# 3-point Gauss-Legendre nodes/weights on [0, 1]: exact for degree <= 5.
+# Plain numpy (weakly typed) so the working dtype follows the inputs — baking
+# jnp arrays at import time would freeze them at the then-current default.
+_GL_X = np.array([0.5 - math.sqrt(3.0 / 20.0), 0.5, 0.5 + math.sqrt(3.0 / 20.0)])
+_GL_W = np.array([5.0 / 18.0, 8.0 / 18.0, 5.0 / 18.0])
+
+
+def _sections(dA: Array, dB: Array):
+    """Linear side lengths at the 3 quadrature points: (..., 3, 2)."""
+    xi = _GL_X  # (3,)
+    return dA[..., None, :] + (dB - dA)[..., None, :] * xi[:, None]
+
+
+def _areas(s: Array, circ: Array) -> Array:
+    """Cross-section areas at quadrature points: (..., 3)."""
+    a_circ = 0.25 * jnp.pi * s[..., 0] * s[..., 1]   # pi/4 d^2 (with s=[d,d])
+    a_rect = s[..., 0] * s[..., 1]
+    return jnp.where(circ[..., None], a_circ, a_rect)
+
+
+def frustum_vcv(dA: Array, dB: Array, H: Array, circ: Array):
+    """Volume and axial center-of-volume height of a linear frustum.
+
+    Equivalent of FrustumVCV (raft/raft.py:873-900).
+    dA, dB: (...,2) side-length pairs; H: (...,); circ: (...,) bool.
+    Returns (V, hc): volume and centroid height above the lower face.
+    """
+    s = _sections(dA, dB)
+    A = _areas(s, circ)                       # (...,3)
+    V = H * jnp.einsum("q,...q->...", _GL_W, A)
+    Mz = H * H * jnp.einsum("q,q,...q->...", _GL_W, _GL_X, A)
+    hc = Mz / jnp.where(V != 0, V, 1.0)
+    return V, hc
+
+
+def frustum_moi(dA: Array, dB: Array, H: Array, rho: Array, circ: Array):
+    """Moments of inertia of a solid linear frustum about its lower end node.
+
+    Equivalent of FrustumMOI / RectangularFrustumMOI
+    (raft/raft.py:251-269, 271-332) with local axes: x,y transverse at the
+    lower end node on the member axis, z axial.
+
+    Returns (Ixx_end, Iyy_end, Izz): Ixx/Iyy about the end node, Izz about
+    the axis (same at any axial position).
+    """
+    s = _sections(dA, dB)                     # (...,3,2)
+    L, W = s[..., 0], s[..., 1]
+    xi = _GL_X
+    z2 = (H[..., None] * xi) ** 2             # (...,3)
+
+    # circular: section inertias pi/64 d^4 about both transverse axes, pi/32 d^4 polar
+    d4 = (L * L) * (W * W)                    # d^4 for circular ([d,d])
+    ixx_c = jnp.pi / 64.0 * d4
+    izz_c = jnp.pi / 32.0 * d4
+    A_c = 0.25 * jnp.pi * L * W
+    # rectangular: (1/12) L W^3 about x, (1/12) L^3 W about y
+    ixx_r = (L * W**3) / 12.0
+    iyy_r = (L**3 * W) / 12.0
+    A_r = L * W
+
+    c = circ[..., None]
+    ixx = jnp.where(c, ixx_c, ixx_r)
+    iyy = jnp.where(c, ixx_c, iyy_r)
+    izz = jnp.where(c, izz_c, ixx_r + iyy_r)
+    A = jnp.where(c, A_c, A_r)
+
+    w = _GL_W
+    Ixx_end = rho * H * jnp.einsum("q,...q->...", w, ixx + A * z2)
+    Iyy_end = rho * H * jnp.einsum("q,...q->...", w, iyy + A * z2)
+    Izz = rho * H * jnp.einsum("q,...q->...", w, izz)
+    return Ixx_end, Iyy_end, Izz

@@ -1,0 +1,204 @@
+"""Unrolled small-matrix linear algebra for the 6-DOF hot path.
+
+The RAO solve is thousands of *independent* 6x6 complex solves (one per
+frequency bin per design — cf. the reference's per-frequency loop
+``Xi = inv(Z) @ F`` at raft/raft.py:1528-1533).  Generic batched linalg is
+unavailable on this TPU backend (LU/Cholesky/eigh lower to UNIMPLEMENTED
+custom calls), and would be a poor fit anyway: for n=6, fully unrolled
+elimination compiles to a single fused elementwise kernel over the batch,
+with no dynamic control flow.
+
+Everything here is batch-broadcast over leading axes and differentiable.
+
+Kernels:
+  * :func:`solve_cx`   — complex 6x6 solve (Gaussian elimination, partial
+                         pivoting) on :class:`~raft_tpu.core.cplx.Cx` pairs.
+  * :func:`solve_re`   — same for real systems.
+  * :func:`eigh_jacobi`— symmetric eigendecomposition by fixed-sweep cyclic
+                         Jacobi rotations (replaces np.linalg.eig of the
+                         reference solveEigen, raft/raft.py:1394).
+  * :func:`cholesky`   — unrolled Cholesky for SPD mass matrices.
+  * :func:`generalized_eigh` — K x = lambda M x via Cholesky + Jacobi.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from raft_tpu.core.cplx import Cx
+
+Array = jnp.ndarray
+
+
+def _pivot_rows(col_mag: Array, k: int, n: int):
+    """Row-permutation indices swapping row k with the max-magnitude row >= k.
+
+    col_mag: (..., n) magnitudes of column k (entries < k should be masked
+    by the caller).  Returns (..., n) int32 gather indices.
+    """
+    rows = jnp.arange(n)
+    masked = jnp.where(rows >= k, col_mag, -1.0)
+    piv = jnp.argmax(masked, axis=-1)  # (...,)
+    pivb = piv[..., None]
+    idx = jnp.broadcast_to(rows, masked.shape)
+    idx = jnp.where(idx == k, pivb, jnp.where(idx == pivb, k, idx))
+    return idx
+
+
+def _gather_rows(A: Array, idx: Array) -> Array:
+    """Gather rows of (...,n,m) by (...,n) indices."""
+    return jnp.take_along_axis(A, idx[..., None], axis=-2)
+
+
+def solve_cx(A: Cx, b: Cx, n: int = 6) -> Cx:
+    """Solve complex A x = b, A: (...,n,n) Cx, b: (...,n) or (...,n,m) Cx.
+
+    Unrolled Gaussian elimination with partial pivoting; all ops elementwise
+    or gathers, so the whole batch compiles to one fused kernel.
+    """
+    vec = b.re.ndim == A.re.ndim - 1
+    if vec:
+        b = Cx(b.re[..., None], b.im[..., None])
+    Ar, Ai = A.re, A.im
+    br, bi = b.re, b.im
+    for k in range(n):
+        mag = Ar[..., :, k] ** 2 + Ai[..., :, k] ** 2  # (...,n)
+        idx = _pivot_rows(mag, k, n)
+        Ar = _gather_rows(Ar, idx)
+        Ai = _gather_rows(Ai, idx)
+        br = _gather_rows(br, idx)
+        bi = _gather_rows(bi, idx)
+        # eliminate rows below k
+        den = Ar[..., k, k] ** 2 + Ai[..., k, k] ** 2
+        den = jnp.where(den != 0, den, 1.0)
+        fr = (Ar[..., :, k] * Ar[..., k : k + 1, k] + Ai[..., :, k] * Ai[..., k : k + 1, k]) / den[..., None]
+        fi = (Ai[..., :, k] * Ar[..., k : k + 1, k] - Ar[..., :, k] * Ai[..., k : k + 1, k]) / den[..., None]
+        below = jnp.arange(n) > k
+        fr = jnp.where(below, fr, 0.0)
+        fi = jnp.where(below, fi, 0.0)
+        Ar, Ai = (
+            Ar - (fr[..., None] * Ar[..., k : k + 1, :] - fi[..., None] * Ai[..., k : k + 1, :]),
+            Ai - (fr[..., None] * Ai[..., k : k + 1, :] + fi[..., None] * Ar[..., k : k + 1, :]),
+        )
+        br, bi = (
+            br - (fr[..., None] * br[..., k : k + 1, :] - fi[..., None] * bi[..., k : k + 1, :]),
+            bi - (fr[..., None] * bi[..., k : k + 1, :] + fi[..., None] * br[..., k : k + 1, :]),
+        )
+    # back substitution
+    xr = jnp.zeros_like(br)
+    xi = jnp.zeros_like(bi)
+    for k in range(n - 1, -1, -1):
+        sr = br[..., k, :] - (
+            jnp.einsum("...j,...jm->...m", Ar[..., k, k + 1 :], xr[..., k + 1 :, :])
+            - jnp.einsum("...j,...jm->...m", Ai[..., k, k + 1 :], xi[..., k + 1 :, :])
+        )
+        si = bi[..., k, :] - (
+            jnp.einsum("...j,...jm->...m", Ar[..., k, k + 1 :], xi[..., k + 1 :, :])
+            + jnp.einsum("...j,...jm->...m", Ai[..., k, k + 1 :], xr[..., k + 1 :, :])
+        )
+        den = Ar[..., k, k] ** 2 + Ai[..., k, k] ** 2
+        den = jnp.where(den != 0, den, 1.0)[..., None]
+        xk_r = (sr * Ar[..., k, k][..., None] + si * Ai[..., k, k][..., None]) / den
+        xk_i = (si * Ar[..., k, k][..., None] - sr * Ai[..., k, k][..., None]) / den
+        xr = xr.at[..., k, :].set(xk_r)
+        xi = xi.at[..., k, :].set(xk_i)
+    x = Cx(xr, xi)
+    if vec:
+        x = Cx(x.re[..., 0], x.im[..., 0])
+    return x
+
+
+def solve_re(A: Array, b: Array, n: int = 6) -> Array:
+    """Real A x = b via the complex kernel (zero imaginary part)."""
+    out = solve_cx(Cx(A, jnp.zeros_like(A)), Cx(b, jnp.zeros_like(b)), n=n)
+    return out.re
+
+
+def cholesky(M: Array, n: int = 6) -> Array:
+    """Unrolled Cholesky factor L (lower) of SPD M: (...,n,n)."""
+    L = jnp.zeros_like(M)
+    for j in range(n):
+        s = M[..., j, j] - jnp.einsum("...k,...k->...", L[..., j, :j], L[..., j, :j])
+        ljj = jnp.sqrt(jnp.maximum(s, 1e-30))
+        L = L.at[..., j, j].set(ljj)
+        for i in range(j + 1, n):
+            s = M[..., i, j] - jnp.einsum("...k,...k->...", L[..., i, :j], L[..., j, :j])
+            L = L.at[..., i, j].set(s / ljj)
+    return L
+
+
+def solve_lower(L: Array, b: Array, n: int = 6) -> Array:
+    """Solve L y = b with L lower-triangular, b: (...,n) or (...,n,m)."""
+    vec = b.ndim == L.ndim - 1
+    if vec:
+        b = b[..., None]
+    y = jnp.zeros_like(b)
+    for i in range(n):
+        s = b[..., i, :] - jnp.einsum("...k,...km->...m", L[..., i, :i], y[..., :i, :])
+        y = y.at[..., i, :].set(s / L[..., i, i][..., None])
+    return y[..., 0] if vec else y
+
+
+def solve_upper(U: Array, b: Array, n: int = 6) -> Array:
+    """Solve U y = b with U upper-triangular."""
+    vec = b.ndim == U.ndim - 1
+    if vec:
+        b = b[..., None]
+    y = jnp.zeros_like(b)
+    for i in range(n - 1, -1, -1):
+        s = b[..., i, :] - jnp.einsum("...k,...km->...m", U[..., i, i + 1 :], y[..., i + 1 :, :])
+        y = y.at[..., i, :].set(s / U[..., i, i][..., None])
+    return y[..., 0] if vec else y
+
+
+def eigh_jacobi(M: Array, n: int = 6, sweeps: int = 12):
+    """Eigendecomposition of symmetric M by cyclic Jacobi rotations.
+
+    Returns (eigvals (...,n), eigvecs (...,n,n) with columns as vectors).
+    Fixed sweep count -> static control flow; 12 sweeps is far past
+    convergence for n=6 (quadratic convergence after ~3).
+    """
+    A = M
+    V = jnp.zeros_like(M) + jnp.eye(n, dtype=M.dtype)
+    for _ in range(sweeps):
+        for p in range(n - 1):
+            for q in range(p + 1, n):
+                app = A[..., p, p]
+                aqq = A[..., q, q]
+                apq = A[..., p, q]
+                # rotation angle: theta = 0.5 atan2(2 apq, aqq - app)
+                theta = 0.5 * jnp.arctan2(2.0 * apq, aqq - app)
+                c = jnp.cos(theta)[..., None]
+                s = jnp.sin(theta)[..., None]
+                # apply rotation on rows/cols p and q
+                rowp = A[..., p, :]
+                rowq = A[..., q, :]
+                A = A.at[..., p, :].set(c * rowp - s * rowq)
+                A = A.at[..., q, :].set(s * rowp + c * rowq)
+                colp = A[..., :, p]
+                colq = A[..., :, q]
+                A = A.at[..., :, p].set(c * colp - s * colq)
+                A = A.at[..., :, q].set(s * colp + c * colq)
+                vp = V[..., :, p]
+                vq = V[..., :, q]
+                V = V.at[..., :, p].set(c * vp - s * vq)
+                V = V.at[..., :, q].set(s * vp + c * vq)
+    return jnp.diagonal(A, axis1=-2, axis2=-1), V
+
+
+def generalized_eigh(K: Array, M: Array, n: int = 6, sweeps: int = 12):
+    """Solve K x = lambda M x for symmetric K, SPD M.
+
+    Used for natural frequencies (reference solveEigen uses eig(inv(M) C),
+    raft/raft.py:1394; the symmetric reduction here is the numerically sound
+    equivalent).  Returns (lambda (...,n), modes (...,n,n) columns).
+    """
+    L = cholesky(M, n=n)
+    # A = L^-1 K L^-T
+    Y = solve_lower(L, K, n=n)                       # L Y = K
+    # Solve L Z^T = Y^T  => Z = Y L^-T: apply lower solve on transposed
+    Z = solve_lower(L, jnp.swapaxes(Y, -1, -2), n=n)
+    A = 0.5 * (Z + jnp.swapaxes(Z, -1, -2))          # symmetrize roundoff
+    lam, V = eigh_jacobi(A, n=n, sweeps=sweeps)
+    # modes: x = L^-T v
+    X = solve_upper(jnp.swapaxes(L, -1, -2), V, n=n)
+    return lam, X

@@ -1,0 +1,171 @@
+"""Rigid-body transform kernels (pure jnp, batch-friendly).
+
+Functional equivalents of the reference's module-level helpers
+(``getH``/``translateForce3to6DOF``/``translateMatrix3to6DOF``/
+``translateMatrix6to6DOF``/``VecVecTrans``/``SmallRotate`` at
+raft/raft.py:998-1102), re-designed so that every function broadcasts over
+arbitrary leading batch axes — one call handles all segments/nodes of a
+platform, or a whole batch of designs, without Python loops.
+
+Deviation from the reference: ``SmallRotate`` in the reference overwrites all
+three components into element 0 (raft/raft.py:1002-1005, acknowledged broken
+in-code); ``small_rotation_displacement`` here implements the intended
+cross-product form.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+Array = jnp.ndarray
+
+
+def alternator(r: Array) -> Array:
+    """H(r) matrix with H[0,1]=z, H[0,2]=-y, H[1,2]=x (antisymmetric).
+
+    This is the "alternator" layout used by the 6-DOF translation identities
+    (cf. reference getH, raft/raft.py:1022-1032).  Note ``H(r) @ f = f x r``
+    and ``H(r).T @ f = r x f``.
+
+    r: (..., 3) -> (..., 3, 3)
+    """
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    zero = jnp.zeros_like(x)
+    return jnp.stack(
+        [
+            jnp.stack([zero, z, -y], axis=-1),
+            jnp.stack([-z, zero, x], axis=-1),
+            jnp.stack([y, -x, zero], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def vec_outer(v: Array) -> Array:
+    """Outer product v v^T, (...,3) -> (...,3,3) (cf. VecVecTrans raft/raft.py:1010)."""
+    return v[..., :, None] * v[..., None, :]
+
+
+def translate_force_3to6(r: Array, f: Array) -> Array:
+    """Force applied at point r -> 6-DOF force/moment about the origin.
+
+    (cf. translateForce3to6DOF raft/raft.py:1036-1051)
+    r: (...,3), f: (...,3) -> (...,6). Complex-safe.
+    """
+    return jnp.concatenate([f, jnp.cross(r, f)], axis=-1)
+
+
+def translate_matrix_3to6(r: Array, M: Array) -> Array:
+    """3x3 mass-like matrix at point r -> 6x6 about the origin.
+
+    (cf. translateMatrix3to6DOF raft/raft.py:1056-1079)
+    r: (...,3), M: (...,3,3) -> (...,6,6)
+    """
+    H = alternator(r)
+    MH = M @ H
+    top = jnp.concatenate([M, MH], axis=-1)
+    HT = jnp.swapaxes(H, -1, -2)
+    bot = jnp.concatenate([jnp.swapaxes(MH, -1, -2), H @ M @ HT], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def translate_matrix_6to6(r: Array, M: Array) -> Array:
+    """6x6 matrix about a point at r -> 6x6 about the origin.
+
+    (cf. translateMatrix6to6DOF raft/raft.py:1082-1102)
+    r: (...,3), M: (...,6,6) -> (...,6,6)
+    """
+    H = alternator(r)
+    HT = jnp.swapaxes(H, -1, -2)
+    m = M[..., :3, :3]
+    J = M[..., :3, 3:]
+    I = M[..., 3:, 3:]
+    JT = jnp.swapaxes(J, -1, -2)
+    Jp = m @ H + J
+    Ip = H @ m @ HT + JT @ H + HT @ J + I
+    top = jnp.concatenate([m, Jp], axis=-1)
+    bot = jnp.concatenate([jnp.swapaxes(Jp, -1, -2), Ip], axis=-1)
+    return jnp.concatenate([top, bot], axis=-2)
+
+
+def small_rotation_displacement(r: Array, th: Array) -> Array:
+    """Displacement of a point at r under small rotations th: th x r.
+
+    Intended behavior of the reference SmallRotate (raft/raft.py:998-1006,
+    which has an acknowledged indexing bug); used for platform-motion node
+    kinematics (getVelocity, raft/raft.py:903-919).
+    Broadcasts; complex-safe (th may be a complex amplitude).
+    """
+    return jnp.cross(th, jnp.broadcast_to(r, jnp.broadcast_shapes(r.shape, th.shape)))
+
+
+def euler_z1y2z3(beta: Array, phi: Array, gamma: Array) -> Array:
+    """Z1Y2Z3 Euler rotation matrix (cf. Member.calcOrientation raft/raft.py:205-242).
+
+    beta: heading from x axis, phi: incline from vertical, gamma: twist [rad].
+    Broadcasts over leading axes -> (...,3,3).
+    """
+    s1, c1 = jnp.sin(beta), jnp.cos(beta)
+    s2, c2 = jnp.sin(phi), jnp.cos(phi)
+    s3, c3 = jnp.sin(gamma), jnp.cos(gamma)
+    z = jnp.zeros_like(s1 + s2 + s3)
+    r00 = c1 * c2 * c3 - s1 * s3
+    r01 = -c3 * s1 - c1 * c2 * s3
+    r02 = c1 * s2
+    r10 = c1 * s3 + c2 * c3 * s1
+    r11 = c1 * c3 - c2 * s1 * s3
+    r12 = s1 * s2
+    r20 = -c3 * s2 + z
+    r21 = s2 * s3 + z
+    r22 = c2 + z
+    return jnp.stack(
+        [
+            jnp.stack([r00, r01, r02], axis=-1),
+            jnp.stack([r10, r11, r12], axis=-1),
+            jnp.stack([r20, r21, r22], axis=-1),
+        ],
+        axis=-2,
+    )
+
+
+def member_orientation(rA: Array, rB: Array, gamma: Array):
+    """Axial/transverse unit vectors and rotation matrix of a member.
+
+    Equivalent of Member.calcOrientation (raft/raft.py:205-242): q along the
+    member axis, p1/p2 transverse, R the Z1Y2Z3 matrix built from the member's
+    heading (beta), incline (phi) and twist (gamma).
+
+    rA,rB: (...,3); gamma: (...) [rad] -> (q, p1, p2, R)
+    """
+    rAB = rB - rA
+    l = jnp.linalg.norm(rAB, axis=-1, keepdims=True)
+    q = rAB / jnp.where(l > 0, l, 1.0)
+    beta = jnp.arctan2(q[..., 1], q[..., 0])
+    phi = jnp.arctan2(jnp.sqrt(q[..., 0] ** 2 + q[..., 1] ** 2), q[..., 2])
+    R = euler_z1y2z3(beta, phi, gamma)
+    e1 = jnp.zeros_like(q).at[..., 0].set(1.0)
+    p1 = jnp.einsum("...ij,...j->...i", R, e1)
+    p2 = jnp.cross(q, p1)
+    return q, p1, p2, R
+
+
+def heading_rotation(heading_deg: Array) -> Array:
+    """Member-pattern heading rotation about z.
+
+    Matches the reference convention for replicated member patterns
+    (raft/raft.py:71-77): rotMat = [[c, s, 0], [-s, c, 0], [0, 0, 1]] with
+    c/s of +heading — i.e. a clockwise rotation of coordinates.  Kept
+    identical so replicated geometries (e.g. OC4 offset columns) land at the
+    reference's positions.
+    """
+    a = jnp.deg2rad(heading_deg)
+    c, s = jnp.cos(a), jnp.sin(a)
+    z = jnp.zeros_like(c)
+    o = jnp.ones_like(c)
+    return jnp.stack(
+        [
+            jnp.stack([c, s, z], axis=-1),
+            jnp.stack([-s, c, z], axis=-1),
+            jnp.stack([z, z, o], axis=-1),
+        ],
+        axis=-2,
+    )

@@ -1,0 +1,168 @@
+"""Core pytree container types.
+
+Everything between "design parameters" and "response statistics" in raft_tpu
+is a pure function over these containers, so they are all registered JAX
+pytrees (via ``flax.struct.dataclass``): they can be passed through ``jit``,
+``vmap``, ``grad`` and sharded over device meshes.
+
+Capability map to the reference (dzalkind/RAFT):
+  * ``Env``         <- environment container, raft/raft.py:22-30
+  * ``MemberSet``   <- the list of per-object ``Member`` instances built at
+                       raft/raft.py:1770-1783, re-designed as flat, stacked,
+                       masked arrays (segments + strip nodes) so a single
+                       platform is one pytree and a batch of designs is the
+                       same pytree with a leading axis.
+  * ``RigidBodyCoeffs`` <- the M/B/C/W matrices assembled by
+                       FOWT.calcStatics, raft/raft.py:1836-2012
+  * ``HydroCoeffs`` <- A_BEM/B_BEM/F_BEM arrays, raft/raft.py:1797-1800
+"""
+from __future__ import annotations
+
+from typing import Optional
+
+import jax.numpy as jnp
+from flax import struct
+
+Array = jnp.ndarray
+
+
+@struct.dataclass
+class Env:
+    """Environmental conditions (sea state + wind + constants)."""
+
+    rho: Array = struct.field(default=1025.0)    # water density [kg/m^3]
+    g: Array = struct.field(default=9.81)        # gravity [m/s^2]
+    Hs: Array = struct.field(default=1.0)        # significant wave height [m]
+    Tp: Array = struct.field(default=10.0)       # peak period [s]
+    V: Array = struct.field(default=10.0)        # wind speed [m/s]
+    beta: Array = struct.field(default=0.0)      # wave heading [rad]
+    depth: Array = struct.field(default=300.0)   # water depth [m]
+
+
+@struct.dataclass
+class MemberSet:
+    """All platform + tower members of one design as flat stacked arrays.
+
+    Two flat axes:
+
+    * ``S`` — one entry per *segment* (a station-to-station span of some
+      member).  Drives inertia + hydrostatics (reference ``Member.getInertia``
+      raft/raft.py:246-641 and ``Member.getHydrostatics`` raft/raft.py:646-796
+      loop over exactly these spans).  End caps/bulkheads are folded into this
+      axis as extra "cap segments" flagged by ``seg_is_cap``.
+
+    * ``N`` — one entry per strip-theory *node* (reference discretization at
+      raft/raft.py:147-191).  Drives Morison added mass / excitation / drag.
+
+    All per-segment and per-node quantities carry the member's orientation
+    (q, p1, p2 unit vectors and rotation matrix R) so no object lookup is ever
+    needed; a design batch is simply this pytree with a leading batch axis.
+
+    Shape-static invariant: for a fixed design *topology* (member count,
+    station counts, node counts) all arrays have fixed shapes; continuous
+    geometry changes (diameters, drafts, ballast, coefficients) only change
+    values.  That is what makes 1000-design ``vmap`` sweeps and ``jax.grad``
+    w.r.t. geometry possible.
+    """
+
+    # ---- per-segment arrays (axis S) ----
+    seg_rA: Array          # (S,3) lower end of segment in global frame [m]
+    seg_q: Array           # (S,3) member axial unit vector
+    seg_R: Array           # (S,3,3) member rotation matrix (Z1Y2Z3)
+    seg_l: Array           # (S,)  segment length [m]
+    seg_dA: Array          # (S,2) outer side lengths (circular: [d,d]) at lower end
+    seg_dB: Array          # (S,2) outer side lengths at upper end
+    seg_tA: Array          # (S,)  wall thickness at lower end [m]
+    seg_tB: Array          # (S,)  wall thickness at upper end [m]
+    seg_l_fill: Array      # (S,)  ballast fill length within segment [m]
+    seg_rho_fill: Array    # (S,)  ballast density [kg/m^3]
+    seg_rho_shell: Array   # (S,)  shell material density [kg/m^3]
+    seg_circ: Array        # (S,)  bool: circular (True) vs rectangular
+    seg_is_cap: Array      # (S,)  bool: this segment is an end cap / bulkhead
+    seg_solid: Array       # (S,)  bool: treat as solid (caps: inner dims are the hole)
+    seg_member: Array      # (S,)  int: owning member id
+    seg_type: Array        # (S,)  int: member type code (<=1 tower, >1 substructure)
+    seg_mask: Array        # (S,)  bool: valid segment (False = padding)
+
+    # ---- per-node arrays (axis N) ----
+    node_r: Array          # (N,3) node position in global frame [m]
+    node_q: Array          # (N,3) axial unit vector of owning member
+    node_p1: Array         # (N,3) transverse unit vector 1
+    node_p2: Array         # (N,3) transverse unit vector 2
+    node_ds: Array         # (N,2) mean side lengths of strip (circular: [d,d]) [m]
+    node_drs: Array        # (N,2) change in radius/half-side over strip [m]
+    node_dls: Array        # (N,)  lumped strip length [m]
+    node_Cd_q: Array       # (N,)  axial drag coefficient
+    node_Cd_p1: Array      # (N,)  transverse drag coefficient 1
+    node_Cd_p2: Array      # (N,)  transverse drag coefficient 2
+    node_Cd_end: Array     # (N,)  end/axial drag coefficient
+    node_Ca_q: Array       # (N,)  axial added-mass coefficient
+    node_Ca_p1: Array      # (N,)  transverse added-mass coefficient 1
+    node_Ca_p2: Array      # (N,)  transverse added-mass coefficient 2
+    node_Ca_end: Array     # (N,)  end/axial added-mass coefficient
+    node_circ: Array       # (N,)  bool circular
+    node_member: Array     # (N,)  int owning member id
+    node_mask: Array       # (N,)  bool valid node (False = padding)
+
+
+@struct.dataclass
+class RigidBodyCoeffs:
+    """6-DOF rigid-body coefficient set about the PRP.
+
+    The output of the statics assembly (reference FOWT.calcStatics,
+    raft/raft.py:1836-2012), plus bookkeeping totals used for reporting and
+    for the mooring body model.
+    """
+
+    M_struc: Array         # (6,6) structural mass/inertia
+    C_struc: Array         # (6,6) structural stiffness (CG gravity terms)
+    W_struc: Array         # (6,)  weight force/moment vector
+    C_hydro: Array         # (6,6) hydrostatic stiffness
+    W_hydro: Array         # (6,)  buoyancy force/moment vector
+    # report totals
+    mass: Array            # () total mass [kg]
+    rCG: Array             # (3,) total center of gravity [m]
+    V: Array               # () displaced volume [m^3]
+    rCB: Array             # (3,) center of buoyancy [m]
+    AWP: Array             # () total waterplane area [m^2]
+    IWPx: Array            # () waterplane inertia about x (incl. spacing) [m^4]
+    IWPy: Array            # () waterplane inertia about y [m^4]
+    zMeta: Array           # () metacenter elevation [m]
+    # substructure/tower split (reference raft/raft.py:1898-1912)
+    m_tower: Array         # () tower mass
+    rCG_tower: Array       # (3,)
+    m_sub: Array           # () substructure mass
+    rCG_sub: Array         # (3,)
+    m_shell: Array         # () substructure shell mass
+    m_ballast: Array       # () total ballast mass
+    I44: Array             # () roll inertia of substructure about its CG
+    I55: Array             # () pitch inertia of substructure about its CG
+    I66: Array             # () yaw inertia of substructure about its centerline
+    I44B: Array            # () roll inertia of substructure about the PRP
+    I55B: Array            # () pitch inertia about PRP
+
+
+@struct.dataclass
+class HydroCoeffs:
+    """Frequency-dependent hydrodynamic coefficient set.
+
+    Holds the BEM (potential-flow) arrays — zero if no BEM data is staged,
+    matching reference behavior at raft/raft.py:1797-1800 — and the Morison
+    strip-theory terms from FOWT.calcHydroConstants (raft/raft.py:2076-2157).
+    """
+
+    A_bem: Array           # (6,6,nw) added mass
+    B_bem: Array           # (6,6,nw) radiation damping
+    F_bem: Array           # (6,nw) complex excitation
+    A_morison: Array       # (6,6)  strip-theory added mass
+    F_morison: Array       # (6,nw) complex Froude-Krylov + dynamic pressure excitation
+
+
+@struct.dataclass
+class WaveState:
+    """Discretized sea state on the frequency grid."""
+
+    w: Array               # (nw,) angular frequencies [rad/s]
+    k: Array               # (nw,) wave numbers [1/m]
+    zeta: Array            # (nw,) wave amplitude spectrum sqrt(S(w)) [m] —
+    #                        matches the reference convention raft/raft.py:1825

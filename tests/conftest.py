@@ -1,0 +1,19 @@
+"""Test configuration: run on a virtual 8-device CPU mesh with float64.
+
+Multi-chip sharding is validated on virtual CPU devices
+(xla_force_host_platform_device_count); the driver separately dry-runs the
+multi-chip path, and bench.py runs on the real TPU chip.
+"""
+import os
+
+# NOTE: the environment may pin JAX_PLATFORMS to a hardware plugin via
+# sitecustomize; jax.config.update below takes precedence over the env var.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+import jax  # noqa: E402
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)

@@ -1,0 +1,69 @@
+"""Cx (re,im)-pair complex arithmetic vs numpy complex oracle."""
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import cplx
+from raft_tpu.core.cplx import Cx
+
+rng = np.random.default_rng(42)
+
+
+def _rand(shape):
+    return rng.normal(size=shape) + 1j * rng.normal(size=shape)
+
+
+def test_arithmetic_matches_numpy():
+    a = _rand((4, 5))
+    b = _rand((4, 5))
+    A, B = Cx.of(a), Cx.of(b)
+    np.testing.assert_allclose(np.asarray((A + B).to_complex()), a + b, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray((A - B).to_complex()), a - b, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray((A * B).to_complex()), a * b, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray((A / B).to_complex()), a / b, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray((-A).to_complex()), -a, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(A.conj().to_complex()), np.conj(a), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(A.mul_i().to_complex()), 1j * a, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray(A.abs()), np.abs(a), rtol=1e-12)
+    np.testing.assert_allclose(np.asarray((A * 2.5).to_complex()), a * 2.5, rtol=1e-12)
+    np.testing.assert_allclose(np.asarray((A + 1.0).to_complex()), a + 1.0, rtol=1e-12)
+
+
+def test_expi():
+    th = rng.normal(size=7)
+    np.testing.assert_allclose(
+        np.asarray(Cx.expi(jnp.asarray(th)).to_complex()), np.exp(1j * th), rtol=1e-12
+    )
+
+
+def test_einsum_two_complex():
+    a = _rand((3, 4))
+    b = _rand((4, 5))
+    out = cplx.einsum("ij,jk->ik", Cx.of(a), Cx.of(b))
+    np.testing.assert_allclose(np.asarray(out.to_complex()), a @ b, rtol=1e-12)
+
+
+def test_einsum_mixed_real_complex():
+    a = rng.normal(size=(3, 4))
+    b = _rand((4,))
+    out = cplx.einsum("ij,j->i", jnp.asarray(a), Cx.of(b))
+    np.testing.assert_allclose(np.asarray(out.to_complex()), a @ b, rtol=1e-12)
+
+
+def test_matmul():
+    a = _rand((6, 6))
+    b = _rand((6, 2))
+    out = cplx.matmul(Cx.of(a), Cx.of(b))
+    np.testing.assert_allclose(np.asarray(out.to_complex()), a @ b, rtol=1e-12)
+
+
+def test_pytree_through_jit_vmap():
+    import jax
+
+    a = _rand((8, 3))
+
+    @jax.jit
+    def f(z: Cx):
+        return (z * z + z.conj()).abs2()
+
+    out = np.asarray(f(Cx.of(a)))
+    np.testing.assert_allclose(out, np.abs(a * a + np.conj(a)) ** 2, rtol=1e-10)

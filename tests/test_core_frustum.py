@@ -1,0 +1,134 @@
+"""Frustum kernels vs independent closed-form oracles.
+
+Oracles below are the standard closed forms for frustum volume/centroid and
+for solid cylinder / tapered frustum moments of inertia (the same physics the
+reference encodes at raft/raft.py:251-332, 873-900), written independently.
+"""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import frustum
+
+
+def vcv(dA, dB, H, circ=True):
+    a2 = jnp.asarray if False else None
+    dA2 = jnp.asarray([dA, dA] if np.isscalar(dA) else dA, dtype=float)
+    dB2 = jnp.asarray([dB, dB] if np.isscalar(dB) else dB, dtype=float)
+    V, hc = frustum.frustum_vcv(dA2, dB2, jnp.asarray(float(H)), jnp.asarray(circ))
+    return float(V), float(hc)
+
+
+def moi(dA, dB, H, rho, circ=True):
+    dA2 = jnp.asarray([dA, dA] if np.isscalar(dA) else dA, dtype=float)
+    dB2 = jnp.asarray([dB, dB] if np.isscalar(dB) else dB, dtype=float)
+    out = frustum.frustum_moi(dA2, dB2, jnp.asarray(float(H)), jnp.asarray(rho), jnp.asarray(circ))
+    return tuple(float(v) for v in out)
+
+
+def test_cylinder_volume_centroid():
+    V, hc = vcv(2.0, 2.0, 10.0)
+    np.testing.assert_allclose(V, np.pi * 10.0, rtol=1e-12)
+    np.testing.assert_allclose(hc, 5.0, rtol=1e-12)
+
+
+def test_cone_volume_centroid():
+    # full cone tapering to zero: V = (1/3) A H, centroid at H/4 from base
+    V, hc = vcv(4.0, 0.0, 9.0)
+    np.testing.assert_allclose(V, np.pi / 4 * 16 * 9 / 3, rtol=1e-12)
+    np.testing.assert_allclose(hc, 9.0 / 4, rtol=1e-12)
+
+
+def test_frustum_volume_formula():
+    # conical frustum closed form: V = pi H/12 (dA^2 + dA dB + dB^2)
+    dA, dB, H = 9.4, 6.5, 8.0
+    V, hc = vcv(dA, dB, H)
+    np.testing.assert_allclose(V, np.pi * H / 12 * (dA**2 + dA * dB + dB**2), rtol=1e-12)
+    # centroid (pyramidal frustum): hc = H/4 (A1 + 2 Am + 3 A2)/(A1+Am+A2) with Am=pi/4 dA dB
+    A1, A2, Am = np.pi / 4 * dA**2, np.pi / 4 * dB**2, np.pi / 4 * dA * dB
+    np.testing.assert_allclose(hc, H / 4 * (A1 + 2 * Am + 3 * A2) / (A1 + Am + A2), rtol=1e-12)
+
+
+def test_box_volume_centroid():
+    V, hc = vcv([2.0, 3.0], [2.0, 3.0], 5.0, circ=False)
+    np.testing.assert_allclose(V, 30.0, rtol=1e-12)
+    np.testing.assert_allclose(hc, 2.5, rtol=1e-12)
+
+
+def test_rect_proportional_taper_matches_pyramid_formula():
+    # proportional taper: geometric-mean mid-area form is exact -> must agree
+    slA, slB, H = [4.0, 2.0], [2.0, 1.0], 6.0
+    V, hc = vcv(slA, slB, H, circ=False)
+    A1, A2 = 8.0, 2.0
+    Am = np.sqrt(A1 * A2)
+    np.testing.assert_allclose(V, (A1 + A2 + Am) * H / 3, rtol=1e-12)
+    np.testing.assert_allclose(hc, H / 4 * (A1 + 2 * Am + 3 * A2) / (A1 + Am + A2), rtol=1e-12)
+
+
+def test_rect_general_taper_exact_integral():
+    # non-proportional taper: check against numerical integration
+    La, Wa, Lb, Wb, H = 4.0, 2.0, 3.0, 2.5, 7.0
+    xi = np.linspace(0, 1, 200001)
+    L = La + (Lb - La) * xi
+    W = Wa + (Wb - Wa) * xi
+    A = L * W
+    V_num = H * np.trapezoid(A, xi)
+    hc_num = H * H * np.trapezoid(A * xi, xi) / V_num
+    V, hc = vcv([La, Wa], [Lb, Wb], H, circ=False)
+    np.testing.assert_allclose(V, V_num, rtol=1e-8)
+    np.testing.assert_allclose(hc, hc_num, rtol=1e-8)
+
+
+def test_zero_height_and_zero_size():
+    V, hc = vcv(3.0, 3.0, 0.0)
+    assert V == 0.0 and hc == 0.0
+    I = moi(0.0, 0.0, 5.0, 8500.0)
+    assert all(v == 0.0 for v in I)
+
+
+def test_cylinder_moi_closed_form():
+    d, H, rho = 3.0, 12.0, 8500.0
+    r = d / 2
+    Ixx, Iyy, Izz = moi(d, d, H, rho)
+    m = rho * np.pi * r**2 * H
+    # about end node: I = m r^2/4 + m H^2/3 ; axial: m r^2 / 2
+    np.testing.assert_allclose(Ixx, m * r**2 / 4 + m * H**2 / 3, rtol=1e-12)
+    np.testing.assert_allclose(Iyy, Ixx, rtol=1e-12)
+    np.testing.assert_allclose(Izz, m * r**2 / 2, rtol=1e-12)
+
+
+def test_tapered_moi_closed_form():
+    # reference closed forms (raft/raft.py:266-267):
+    # I_rad_end = (1/20) p pi H (r2^5 - r1^5)/(r2-r1) + (1/30) p pi H^3 (r1^2 + 3 r1 r2 + 6 r2^2)
+    # I_ax      = (1/10) p pi H (r2^5 - r1^5)/(r2-r1)
+    dA, dB, H, rho = 9.4, 6.5, 8.0, 1860.0
+    r1, r2 = dA / 2, dB / 2
+    Ixx, Iyy, Izz = moi(dA, dB, H, rho)
+    I_rad = (1 / 20) * rho * np.pi * H * (r2**5 - r1**5) / (r2 - r1) + (
+        1 / 30
+    ) * rho * np.pi * H**3 * (r1**2 + 3 * r1 * r2 + 6 * r2**2)
+    I_ax = (1 / 10) * rho * np.pi * H * (r2**5 - r1**5) / (r2 - r1)
+    np.testing.assert_allclose(Ixx, I_rad, rtol=1e-12)
+    np.testing.assert_allclose(Izz, I_ax, rtol=1e-12)
+
+
+def test_box_moi_closed_form():
+    # cuboid about end node (reference raft/raft.py:289-291):
+    # Ixx = (1/12) M (W^2 + 4 H^2), Iyy = (1/12) M (L^2 + 4 H^2), Izz = (1/12) M (L^2+W^2)
+    L, W, H, rho = 4.0, 2.0, 6.0, 1025.0
+    M = rho * L * W * H
+    Ixx, Iyy, Izz = moi([L, W], [L, W], H, rho, circ=False)
+    np.testing.assert_allclose(Ixx, M * (W**2 + 4 * H**2) / 12, rtol=1e-12)
+    np.testing.assert_allclose(Iyy, M * (L**2 + 4 * H**2) / 12, rtol=1e-12)
+    np.testing.assert_allclose(Izz, M * (L**2 + W**2) / 12, rtol=1e-12)
+
+
+def test_batched_shapes():
+    dA = jnp.ones((7, 2)) * 3.0
+    dB = jnp.ones((7, 2)) * 2.0
+    H = jnp.linspace(1.0, 7.0, 7)
+    circ = jnp.ones(7, dtype=bool)
+    V, hc = frustum.frustum_vcv(dA, dB, H, circ)
+    assert V.shape == (7,) and hc.shape == (7,)
+    I = frustum.frustum_moi(dA, dB, H, jnp.asarray(1000.0), circ)
+    assert all(v.shape == (7,) for v in I)

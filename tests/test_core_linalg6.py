@@ -1,0 +1,132 @@
+"""Unrolled 6x6 kernels vs numpy.linalg oracle."""
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from raft_tpu.core import linalg6
+from raft_tpu.core.cplx import Cx
+
+rng = np.random.default_rng(7)
+
+
+def test_solve_cx_single():
+    A = rng.normal(size=(6, 6)) + 1j * rng.normal(size=(6, 6))
+    b = rng.normal(size=6) + 1j * rng.normal(size=6)
+    x = linalg6.solve_cx(Cx.of(A), Cx.of(b))
+    np.testing.assert_allclose(np.asarray(x.to_complex()), np.linalg.solve(A, b), rtol=1e-10)
+
+
+def test_solve_cx_batched():
+    A = rng.normal(size=(50, 6, 6)) + 1j * rng.normal(size=(50, 6, 6))
+    b = rng.normal(size=(50, 6)) + 1j * rng.normal(size=(50, 6))
+    x = np.asarray(linalg6.solve_cx(Cx.of(A), Cx.of(b)).to_complex())
+    expect = np.linalg.solve(A, b[..., None])[..., 0]
+    np.testing.assert_allclose(x, expect, rtol=1e-8)
+
+
+def test_solve_cx_needs_pivoting():
+    # zero leading pivot forces a row swap
+    A = np.array(
+        [
+            [0.0, 1.0],
+            [1.0, 0.0],
+        ]
+    ) + 0j
+    b = np.array([2.0, 3.0]) + 0j
+    x = linalg6.solve_cx(Cx.of(A), Cx.of(b), n=2)
+    np.testing.assert_allclose(np.asarray(x.to_complex()), [3.0, 2.0], atol=1e-12)
+
+
+def test_solve_cx_impedance_like():
+    # realistic RAO impedance: Z = -w^2 M + i w B + C with large magnitude spread
+    M = np.diag([8e6, 8e6, 8e6, 5e9, 5e9, 1e9])
+    C = np.diag([7e4, 7e4, 3e5, 1e9, 1e9, 1e8])
+    B = 0.05 * np.sqrt(np.diag(M) * np.diag(C))
+    ws = np.linspace(0.05, 3.0, 60)
+    Z = -ws[:, None, None] ** 2 * M + 1j * ws[:, None, None] * np.diag(B) + C
+    Z = Z + rng.normal(size=(6, 6)) * 1e3  # light coupling
+    F = rng.normal(size=(60, 6)) * 1e5 + 1j * rng.normal(size=(60, 6)) * 1e5
+    x = np.asarray(linalg6.solve_cx(Cx.of(Z), Cx.of(F)).to_complex())
+    expect = np.linalg.solve(Z, F[..., None])[..., 0]
+    np.testing.assert_allclose(x, expect, rtol=1e-8)
+
+
+def test_solve_re():
+    A = rng.normal(size=(6, 6)) + 6 * np.eye(6)
+    b = rng.normal(size=(6, 3))
+    x = np.asarray(linalg6.solve_re(jnp.asarray(A), jnp.asarray(b)))
+    np.testing.assert_allclose(x, np.linalg.solve(A, b), rtol=1e-10)
+
+
+def test_cholesky():
+    A = rng.normal(size=(6, 6))
+    M = A @ A.T + 6 * np.eye(6)
+    L = np.asarray(linalg6.cholesky(jnp.asarray(M)))
+    np.testing.assert_allclose(L, np.linalg.cholesky(M), rtol=1e-10)
+
+
+def test_triangular_solves():
+    A = rng.normal(size=(6, 6))
+    M = A @ A.T + 6 * np.eye(6)
+    L = np.linalg.cholesky(M)
+    b = rng.normal(size=6)
+    y = np.asarray(linalg6.solve_lower(jnp.asarray(L), jnp.asarray(b)))
+    np.testing.assert_allclose(y, np.linalg.solve(L, b), rtol=1e-10)
+    z = np.asarray(linalg6.solve_upper(jnp.asarray(L.T), jnp.asarray(b)))
+    np.testing.assert_allclose(z, np.linalg.solve(L.T, b), rtol=1e-10)
+
+
+def test_eigh_jacobi():
+    A = rng.normal(size=(6, 6))
+    S = A + A.T
+    lam, V = linalg6.eigh_jacobi(jnp.asarray(S))
+    lam, V = np.asarray(lam), np.asarray(V)
+    expect = np.sort(np.linalg.eigvalsh(S))
+    np.testing.assert_allclose(np.sort(lam), expect, rtol=1e-9, atol=1e-9)
+    # eigenvector property
+    for i in range(6):
+        np.testing.assert_allclose(S @ V[:, i], lam[i] * V[:, i], atol=1e-7)
+
+
+def test_eigh_jacobi_batched():
+    A = rng.normal(size=(10, 6, 6))
+    S = A + np.swapaxes(A, -1, -2)
+    lam, V = linalg6.eigh_jacobi(jnp.asarray(S))
+    for i in range(10):
+        np.testing.assert_allclose(
+            np.sort(np.asarray(lam[i])), np.sort(np.linalg.eigvalsh(S[i])), rtol=1e-8, atol=1e-8
+        )
+
+
+def test_generalized_eigh_natural_freqs():
+    # K x = lam M x with physical-ish scales: natural freqs of a 6-dof system
+    A = rng.normal(size=(6, 6))
+    M = A @ A.T + np.diag([8e6, 8e6, 8e6, 5e9, 5e9, 1e9])
+    B = rng.normal(size=(6, 6)) * 1e3
+    K = B @ B.T + np.diag([7e4, 7e4, 3e5, 1e9, 1e9, 1e8])
+    lam, X = linalg6.generalized_eigh(jnp.asarray(K), jnp.asarray(M))
+    lam = np.asarray(lam)
+    import scipy.linalg as sla
+
+    expect = np.sort(sla.eigh(K, M, eigvals_only=True))
+    np.testing.assert_allclose(np.sort(lam), expect, rtol=1e-7)
+    # generalized eigenvector check
+    X = np.asarray(X)
+    for i in range(6):
+        r = K @ X[:, i] - lam[i] * (M @ X[:, i])
+        assert np.linalg.norm(r) / np.linalg.norm(K @ X[:, i]) < 1e-6
+
+
+def test_solve_under_jit_grad():
+    A = rng.normal(size=(6, 6)) + 10 * np.eye(6)
+
+    def loss(scale):
+        Az = Cx(jnp.asarray(A) * scale, jnp.asarray(A) * 0.1)
+        b = Cx(jnp.ones(6), jnp.zeros(6))
+        return linalg6.solve_cx(Az, b).abs2().sum()
+
+    g = jax.grad(loss)(1.0)
+    # finite-difference check
+    eps = 1e-6
+    fd = (loss(1.0 + eps) - loss(1.0 - eps)) / (2 * eps)
+    np.testing.assert_allclose(float(g), float(fd), rtol=1e-4)

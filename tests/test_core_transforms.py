@@ -1,0 +1,115 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import transforms as tf
+
+
+def test_alternator_cross_identity():
+    rng = np.random.default_rng(0)
+    r = rng.normal(size=3)
+    f = rng.normal(size=3)
+    H = np.asarray(tf.alternator(jnp.asarray(r)))
+    # H(r) @ f == f x r  and  H.T @ f == r x f
+    np.testing.assert_allclose(H @ f, np.cross(f, r), atol=1e-12)
+    np.testing.assert_allclose(H.T @ f, np.cross(r, f), atol=1e-12)
+
+
+def test_translate_force_moment():
+    r = np.array([1.0, -2.0, 3.0])
+    f = np.array([10.0, 0.0, -5.0])
+    out = np.asarray(tf.translate_force_3to6(jnp.asarray(r), jnp.asarray(f)))
+    np.testing.assert_allclose(out[:3], f)
+    np.testing.assert_allclose(out[3:], np.cross(r, f))
+
+
+def test_translate_matrix_3to6_point_mass():
+    # A point mass m at r must produce the standard 6x6: inertia m*(|r|^2 I - r r^T)
+    m = 7.5
+    r = np.array([2.0, 1.0, -3.0])
+    M3 = m * np.eye(3)
+    M6 = np.asarray(tf.translate_matrix_3to6(jnp.asarray(r), jnp.asarray(M3)))
+    I_expect = m * ((r @ r) * np.eye(3) - np.outer(r, r))
+    np.testing.assert_allclose(M6[:3, :3], M3)
+    np.testing.assert_allclose(M6[3:, 3:], I_expect, rtol=1e-12)
+    # Coupling block: J' = m H(r); check against moment of a unit acceleration
+    # force: (M6 @ [a,0]) moments = r x (m a)
+    a = np.array([1.0, 0.0, 0.0, 0.0, 0.0, 0.0])
+    np.testing.assert_allclose((M6 @ a)[3:], np.cross(r, m * a[:3]), atol=1e-12)
+
+
+def test_translate_matrix_6to6_roundtrip():
+    rng = np.random.default_rng(1)
+    # build a random symmetric 6x6 about CG, translate out and back
+    A = rng.normal(size=(6, 6))
+    M = A + A.T + 12 * np.eye(6)
+    r = rng.normal(size=3)
+    M1 = tf.translate_matrix_6to6(jnp.asarray(r), jnp.asarray(M))
+    M2 = np.asarray(tf.translate_matrix_6to6(jnp.asarray(-r), M1))
+    np.testing.assert_allclose(M2, M, rtol=1e-9, atol=1e-9)
+
+
+def test_translate_matrix_6to6_agrees_with_3to6():
+    m = 3.0
+    r = np.array([0.5, -1.5, 2.0])
+    M6 = np.zeros((6, 6))
+    M6[:3, :3] = m * np.eye(3)
+    out6 = np.asarray(tf.translate_matrix_6to6(jnp.asarray(r), jnp.asarray(M6)))
+    out3 = np.asarray(tf.translate_matrix_3to6(jnp.asarray(r), jnp.asarray(m * np.eye(3))))
+    np.testing.assert_allclose(out6, out3, atol=1e-12)
+
+
+def test_member_orientation_vertical():
+    rA = jnp.array([0.0, 0.0, -120.0])
+    rB = jnp.array([0.0, 0.0, 10.0])
+    q, p1, p2, R = tf.member_orientation(rA, rB, jnp.asarray(0.0))
+    np.testing.assert_allclose(np.asarray(q), [0, 0, 1], atol=1e-12)
+    # R maps local z to global q
+    np.testing.assert_allclose(np.asarray(R @ jnp.array([0.0, 0.0, 1.0])), np.asarray(q), atol=1e-12)
+    # orthonormal triad
+    np.testing.assert_allclose(np.asarray(jnp.cross(q, p1)), np.asarray(p2), atol=1e-12)
+
+
+def test_member_orientation_inclined_triad():
+    rng = np.random.default_rng(2)
+    rA = rng.normal(size=3)
+    rB = rA + rng.normal(size=3)
+    q, p1, p2, R = tf.member_orientation(jnp.asarray(rA), jnp.asarray(rB), jnp.asarray(0.3))
+    q, p1, p2, R = map(np.asarray, (q, p1, p2, R))
+    np.testing.assert_allclose(q, (rB - rA) / np.linalg.norm(rB - rA), atol=1e-12)
+    for v in (q, p1, p2):
+        np.testing.assert_allclose(np.linalg.norm(v), 1.0, atol=1e-12)
+    np.testing.assert_allclose(p1 @ q, 0.0, atol=1e-12)
+    np.testing.assert_allclose(R @ R.T, np.eye(3), atol=1e-12)
+    # R columns are images of the local basis; local z -> q
+    np.testing.assert_allclose(R[:, 2], q, atol=1e-12)
+
+
+def test_small_rotation_displacement():
+    r = np.array([1.0, 2.0, 3.0])
+    th = np.array([0.01, -0.02, 0.005])
+    out = np.asarray(tf.small_rotation_displacement(jnp.asarray(r), jnp.asarray(th)))
+    np.testing.assert_allclose(out, np.cross(th, r), atol=1e-15)
+
+
+def test_heading_rotation_pattern():
+    # 120-degree pattern of a point must form an equilateral triangle set
+    p = np.array([10.0, 0.0, -5.0])
+    Rz = np.asarray(tf.heading_rotation(jnp.asarray(120.0)))
+    p2 = Rz @ p
+    assert abs(np.linalg.norm(p2[:2]) - 10.0) < 1e-12
+    assert abs(p2[2] - p[2]) < 1e-12
+    # three applications come back around
+    p3 = Rz @ Rz @ Rz @ p
+    np.testing.assert_allclose(p3, p, atol=1e-9)
+
+
+def test_batched_broadcasting():
+    rng = np.random.default_rng(3)
+    r = rng.normal(size=(5, 3))
+    M = rng.normal(size=(5, 6, 6))
+    out = tf.translate_matrix_6to6(jnp.asarray(r), jnp.asarray(M))
+    assert out.shape == (5, 6, 6)
+    for i in range(5):
+        one = tf.translate_matrix_6to6(jnp.asarray(r[i]), jnp.asarray(M[i]))
+        np.testing.assert_allclose(np.asarray(out[i]), np.asarray(one), atol=1e-12)

@@ -1,0 +1,103 @@
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.core import waves
+
+
+def test_dispersion_relation_satisfied():
+    w = jnp.linspace(0.05, 3.0, 60)
+    for h in (20.0, 200.0, 320.0, 4000.0):
+        k = np.asarray(waves.wave_number(w, h))
+        resid = np.asarray(w) ** 2 - 9.81 * k * np.tanh(k * h)
+        np.testing.assert_allclose(resid, 0.0, atol=1e-8)
+
+
+def test_dispersion_limits():
+    # deep water: k -> w^2/g ; shallow water: k -> w / sqrt(g h)
+    w = 2.5
+    k_deep = float(waves.wave_number(jnp.asarray(w), 5000.0))
+    np.testing.assert_allclose(k_deep, w**2 / 9.81, rtol=1e-6)
+    w = 0.05
+    h = 10.0
+    k_shal = float(waves.wave_number(jnp.asarray(w), h))
+    np.testing.assert_allclose(k_shal, w / np.sqrt(9.81 * h), rtol=1e-3)
+
+
+def test_jonswap_pierson_moskowitz_moment():
+    # m0 = integral S dw must equal Hs^2/16 for PM (gamma=1)
+    Hs, Tp = 8.0, 12.0
+    w = jnp.linspace(0.01, 6.0, 20000)
+    S = np.asarray(waves.jonswap(w, Hs, Tp, 1.0))
+    m0 = np.trapezoid(S, np.asarray(w))
+    np.testing.assert_allclose(m0, Hs**2 / 16, rtol=2e-3)
+
+
+def test_jonswap_peak_location():
+    Hs, Tp = 6.0, 10.0
+    w = np.linspace(0.1, 3.0, 5000)
+    S = np.asarray(waves.jonswap(jnp.asarray(w), Hs, Tp, 3.3))
+    wp = w[np.argmax(S)]
+    np.testing.assert_allclose(wp, 2 * np.pi / Tp, rtol=2e-2)
+
+
+def test_wave_kinematics_deepwater_oracle():
+    # Deep water: |u| = w * zeta * e^{kz}, ud = i w u, pDyn = rho g zeta e^{kz}
+    h = 5000.0
+    w = jnp.asarray([0.8])
+    k = waves.wave_number(w, h)
+    zeta0 = jnp.asarray([2.0])
+    r = jnp.asarray([0.0, 0.0, -10.0])
+    u, ud, p = waves.wave_kinematics(zeta0, w, k, h, r)
+    u, ud, p = u.to_complex(), ud.to_complex(), p.to_complex()
+    decay = np.exp(float(k[0]) * -10.0)
+    np.testing.assert_allclose(abs(complex(u[0, 0])), 0.8 * 2.0 * decay, rtol=1e-6)
+    np.testing.assert_allclose(abs(complex(u[2, 0])), 0.8 * 2.0 * decay, rtol=1e-6)
+    np.testing.assert_allclose(complex(ud[0, 0]), 1j * 0.8 * complex(u[0, 0]), rtol=1e-12)
+    np.testing.assert_allclose(abs(complex(p[0])), 1025.0 * 9.81 * 2.0 * decay, rtol=1e-6)
+
+
+def test_wave_kinematics_surface_node_dry():
+    h = 200.0
+    w = jnp.asarray([0.5, 1.0])
+    k = waves.wave_number(w, h)
+    zeta0 = jnp.asarray([1.0, 1.0])
+    r_dry = jnp.asarray([0.0, 0.0, 5.0])
+    u, ud, p = waves.wave_kinematics(zeta0, w, k, h, r_dry)
+    assert np.all(np.asarray(u.abs()) == 0) and np.all(np.asarray(p.abs()) == 0)
+
+
+def test_wave_kinematics_phase_shift_with_x():
+    h = 300.0
+    w = jnp.asarray([1.2])
+    k = waves.wave_number(w, h)
+    zeta0 = jnp.asarray([1.0])
+    u0 = waves.wave_kinematics(zeta0, w, k, h, jnp.asarray([0.0, 0.0, -5.0]))[0].to_complex()
+    u1 = waves.wave_kinematics(zeta0, w, k, h, jnp.asarray([30.0, 0.0, -5.0]))[0].to_complex()
+    expected_phase = np.exp(-1j * float(k[0]) * 30.0)
+    np.testing.assert_allclose(
+        complex(u1[0, 0]) / complex(u0[0, 0]), expected_phase, rtol=1e-9
+    )
+
+
+def test_wave_kinematics_batched_nodes():
+    h = 100.0
+    w = jnp.linspace(0.1, 2.0, 10)
+    k = waves.wave_number(w, h)
+    zeta0 = jnp.ones(10)
+    r = jnp.asarray(np.random.default_rng(0).normal(size=(4, 7, 3)) * [5, 5, -10])
+    u, ud, p = waves.wave_kinematics(zeta0, w, k, h, r)
+    assert u.shape == (4, 7, 3, 10) and p.shape == (4, 7, 10)
+
+
+def test_incompressibility_deep_water():
+    # In deep water, du_x/dx + du_z/dz = 0 for the linear potential solution.
+    h = 3000.0
+    w = jnp.asarray([1.0])
+    k = waves.wave_number(w, h)
+    zeta0 = jnp.asarray([1.0])
+    eps = 1e-3
+    f = lambda x, z: waves.wave_kinematics(zeta0, w, k, h, jnp.asarray([x, 0.0, z]))[0].to_complex()
+    dux_dx = (complex(f(eps, -5.0)[0, 0]) - complex(f(-eps, -5.0)[0, 0])) / (2 * eps)
+    duz_dz = (complex(f(0.0, -5.0 + eps)[2, 0]) - complex(f(0.0, -5.0 - eps)[2, 0])) / (2 * eps)
+    np.testing.assert_allclose(dux_dx + duz_dz, 0.0, atol=1e-6)

@@ -16,4 +16,4 @@ def enable_x64():
     jax.config.update("jax_enable_x64", True)
 
 
-from raft_tpu.core.types import Env, HydroCoeffs, MemberSet, RigidBodyCoeffs, WaveState  # noqa: F401,E402
+from raft_tpu.core.types import Env, HydroCoeffs, MemberSet, RigidBodyCoeffs, RNA, WaveState  # noqa: F401,E402

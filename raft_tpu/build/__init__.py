@@ -1,0 +1,2 @@
+"""Host-side preprocessing: design dicts -> device-ready pytrees."""
+from raft_tpu.build.members import build_member_set, build_rna  # noqa: F401

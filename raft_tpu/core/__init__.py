@@ -1,3 +1,3 @@
 """Core math kernels and pytree schemas for raft_tpu."""
 from raft_tpu.core import constants, frustum, transforms, types, waves  # noqa: F401
-from raft_tpu.core.types import Env, HydroCoeffs, MemberSet, RigidBodyCoeffs, WaveState  # noqa: F401
+from raft_tpu.core.types import Env, HydroCoeffs, MemberSet, RigidBodyCoeffs, RNA, WaveState  # noqa: F401

@@ -93,6 +93,10 @@ class Cx:
             )
         return Cx(self.re / o, self.im / o)
 
+    def __rtruediv__(self, o):
+        d = self.abs2()
+        return Cx(o * self.re / d, -o * self.im / d)
+
     def mul_i(self) -> "Cx":
         """Multiply by i (e.g. differentiation in frequency domain)."""
         return Cx(-self.im, self.re)

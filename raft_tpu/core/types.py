@@ -66,20 +66,28 @@ class MemberSet:
     """
 
     # ---- per-segment arrays (axis S) ----
+    # Unified representation: a segment is a linear frustum shell — outer
+    # dims minus inner dims gives the shell; the inner frustum can carry a
+    # ballast fill over its first ``seg_l_fill`` of length.  End caps and
+    # bulkheads are extra segments whose "inner" dims describe the center
+    # hole (0 for a solid plate), so one code path computes everything
+    # (reference treats these as two separate loops, raft/raft.py:346-477
+    # and :484-633).
     seg_rA: Array          # (S,3) lower end of segment in global frame [m]
     seg_q: Array           # (S,3) member axial unit vector
     seg_R: Array           # (S,3,3) member rotation matrix (Z1Y2Z3)
     seg_l: Array           # (S,)  segment length [m]
     seg_dA: Array          # (S,2) outer side lengths (circular: [d,d]) at lower end
     seg_dB: Array          # (S,2) outer side lengths at upper end
-    seg_tA: Array          # (S,)  wall thickness at lower end [m]
-    seg_tB: Array          # (S,)  wall thickness at upper end [m]
+    seg_diA: Array         # (S,2) inner side lengths at lower end (cap: hole dims)
+    seg_diB: Array         # (S,2) inner side lengths at upper end
     seg_l_fill: Array      # (S,)  ballast fill length within segment [m]
     seg_rho_fill: Array    # (S,)  ballast density [kg/m^3]
     seg_rho_shell: Array   # (S,)  shell material density [kg/m^3]
     seg_circ: Array        # (S,)  bool: circular (True) vs rectangular
     seg_is_cap: Array      # (S,)  bool: this segment is an end cap / bulkhead
-    seg_solid: Array       # (S,)  bool: treat as solid (caps: inner dims are the hole)
+    #                        (caps contribute inertia but no hydrostatics,
+    #                         matching the reference's separate cap loop)
     seg_member: Array      # (S,)  int: owning member id
     seg_type: Array        # (S,)  int: member type code (<=1 tower, >1 substructure)
     seg_mask: Array        # (S,)  bool: valid segment (False = padding)
@@ -103,6 +111,24 @@ class MemberSet:
     node_circ: Array       # (N,)  bool circular
     node_member: Array     # (N,)  int owning member id
     node_mask: Array       # (N,)  bool valid node (False = padding)
+
+
+@struct.dataclass
+class RNA:
+    """Lumped rotor-nacelle-assembly properties.
+
+    Mirrors the turbine scalars consumed by the reference FOWT
+    (raft/raft.py:1790-1794) plus thrust/yaw-stiffness knobs
+    (raft/raft.py:1264-1268, runRAFT.py:68).
+    """
+
+    mRNA: Array = struct.field(default=0.0)       # [kg]
+    IxRNA: Array = struct.field(default=0.0)      # [kg m^2] about rotor axis
+    IrRNA: Array = struct.field(default=0.0)      # [kg m^2] about lateral axes
+    xCG_RNA: Array = struct.field(default=0.0)    # [m]
+    hHub: Array = struct.field(default=100.0)     # [m]
+    Fthrust: Array = struct.field(default=0.0)    # [N]
+    yaw_stiffness: Array = struct.field(default=0.0)  # [N m/rad]
 
 
 @struct.dataclass

@@ -1,0 +1,2 @@
+"""Host-side IO: YAML design parsing, validation, results serialization."""
+from raft_tpu.io.schema import get_from_dict  # noqa: F401

@@ -12,7 +12,6 @@ from raft_tpu.core import frustum
 
 
 def vcv(dA, dB, H, circ=True):
-    a2 = jnp.asarray if False else None
     dA2 = jnp.asarray([dA, dA] if np.isscalar(dA) else dA, dtype=float)
     dB2 = jnp.asarray([dB, dB] if np.isscalar(dB) else dB, dtype=float)
     V, hc = frustum.frustum_vcv(dA2, dB2, jnp.asarray(float(H)), jnp.asarray(circ))

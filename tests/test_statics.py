@@ -1,0 +1,276 @@
+"""Statics assembly tests: analytic cylinder cases + OC3 spar sanity checks.
+
+Golden values are closed-form (uniform cylinder) or the public OC3-Hywind
+specification (Jonkman, NREL/TP-500-47535) — not outputs of the reference
+code, which cannot run here (MoorPy absent) and contains documented bugs.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.build.members import build_member_set, build_rna
+from raft_tpu.core.types import Env, RNA
+from raft_tpu.statics import assemble_statics
+
+RHO = 1025.0
+G = 9.81
+
+
+def cylinder_design(d=10.0, t=0.05, z0=-80.0, z1=20.0, rho_shell=8000.0,
+                    l_fill=0.0, rho_fill=0.0):
+    return {
+        "platform": {
+            "members": [
+                {
+                    "name": "cyl",
+                    "type": 2,
+                    "rA": [0, 0, z0],
+                    "rB": [0, 0, z1],
+                    "shape": "circ",
+                    "stations": [z0, z1],
+                    "d": d,
+                    "t": t,
+                    "rho_shell": rho_shell,
+                    "l_fill": l_fill,
+                    "rho_fill": rho_fill,
+                }
+            ]
+        },
+    }
+
+
+def zero_rna():
+    return RNA(mRNA=0.0, IxRNA=0.0, IrRNA=0.0, xCG_RNA=0.0, hHub=0.0)
+
+
+class TestCylinderAnalytic:
+    def setup_method(self):
+        self.d, self.t, self.z0, self.z1 = 10.0, 0.05, -80.0, 20.0
+        self.L = self.z1 - self.z0
+        ms = build_member_set(cylinder_design(self.d, self.t, self.z0, self.z1))
+        self.stat = jax.jit(assemble_statics)(ms, zero_rna(), Env())
+
+    def test_shell_mass(self):
+        di = self.d - 2 * self.t
+        m_exp = 8000.0 * np.pi / 4 * (self.d**2 - di**2) * self.L
+        np.testing.assert_allclose(self.stat.mass, m_exp, rtol=1e-9)
+
+    def test_cg_at_midheight(self):
+        np.testing.assert_allclose(self.stat.rCG[2], 0.5 * (self.z0 + self.z1), rtol=1e-9)
+        np.testing.assert_allclose(self.stat.rCG[:2], 0.0, atol=1e-6)
+
+    def test_displaced_volume_and_cb(self):
+        V_exp = np.pi / 4 * self.d**2 * abs(self.z0)
+        np.testing.assert_allclose(self.stat.V, V_exp, rtol=1e-9)
+        np.testing.assert_allclose(self.stat.rCB[2], self.z0 / 2, rtol=1e-9)
+
+    def test_waterplane(self):
+        A_exp = np.pi / 4 * self.d**2
+        I_exp = np.pi / 64 * self.d**4
+        np.testing.assert_allclose(self.stat.AWP, A_exp, rtol=1e-9)
+        np.testing.assert_allclose(self.stat.IWPx, I_exp, rtol=1e-9)
+
+    def test_heave_stiffness(self):
+        np.testing.assert_allclose(
+            self.stat.C_hydro[2, 2], RHO * G * np.pi / 4 * self.d**2, rtol=1e-9
+        )
+
+    def test_pitch_stiffness(self):
+        # C44_hydro = rho g (IWP + V zCB)
+        V = np.pi / 4 * self.d**2 * abs(self.z0)
+        I = np.pi / 64 * self.d**4
+        C44_exp = RHO * G * (I + V * (self.z0 / 2))
+        np.testing.assert_allclose(self.stat.C_hydro[3, 3], C44_exp, rtol=1e-9)
+        np.testing.assert_allclose(self.stat.C_hydro[4, 4], C44_exp, rtol=1e-9)
+
+    def test_buoyancy_force(self):
+        V = np.pi / 4 * self.d**2 * abs(self.z0)
+        np.testing.assert_allclose(self.stat.W_hydro[2], RHO * G * V, rtol=1e-9)
+        np.testing.assert_allclose(self.stat.W_hydro[:2], 0.0, atol=1e-4)
+
+    def test_weight_force(self):
+        np.testing.assert_allclose(self.stat.W_struc[2], -G * self.stat.mass, rtol=1e-9)
+
+    def test_pitch_inertia_thin_shell(self):
+        # thin-walled tube about its CG: I = m (d^2/8 + L^2/12) (mean radius)
+        m = float(self.stat.mass)
+        rm = (self.d - self.t) / 2
+        I_exp = m * (rm**2 / 2 + self.L**2 / 12)
+        zCG = 0.5 * (self.z0 + self.z1)
+        I_prp = float(self.stat.M_struc[4, 4])
+        I_cg = I_prp - m * zCG**2
+        np.testing.assert_allclose(I_cg, I_exp, rtol=1e-3)
+
+    def test_c_struc_cg_terms(self):
+        zCG = 0.5 * (self.z0 + self.z1)
+        np.testing.assert_allclose(
+            self.stat.C_struc[3, 3], -float(self.stat.mass) * G * zCG, rtol=1e-9
+        )
+
+
+class TestBallast:
+    def test_ballast_mass_and_cg(self):
+        d, t, z0, z1 = 10.0, 0.05, -100.0, 0.0
+        lf, rf = 30.0, 1800.0
+        ms = build_member_set(cylinder_design(d, t, z0, z1, l_fill=lf, rho_fill=rf))
+        stat = assemble_statics(ms, zero_rna(), Env())
+        di = d - 2 * t
+        m_fill = rf * np.pi / 4 * di**2 * lf
+        m_shell = 8000.0 * np.pi / 4 * (d**2 - di**2) * (z1 - z0)
+        np.testing.assert_allclose(stat.mass, m_fill + m_shell, rtol=1e-9)
+        np.testing.assert_allclose(stat.m_ballast, m_fill, rtol=1e-9)
+        zCG_exp = (m_shell * (z0 + z1) / 2 + m_fill * (z0 + lf / 2)) / (m_shell + m_fill)
+        np.testing.assert_allclose(stat.rCG[2], zCG_exp, rtol=1e-9)
+
+
+class TestSubmergedInclined:
+    def test_volume_invariant_under_incline(self):
+        # fully submerged member: displaced volume independent of orientation
+        base = {
+            "name": "pontoon", "type": 2, "shape": "circ",
+            "stations": [0, 40], "d": 4.0, "t": 0.03,
+        }
+        d_vert = {"platform": {"members": [dict(base, rA=[0, 0, -60], rB=[0, 0, -20])]}}
+        h = 40.0 / np.sqrt(2.0)
+        d_incl = {"platform": {"members": [dict(base, rA=[0, 0, -60], rB=[h, 0, -60 + h])]}}
+        s_v = assemble_statics(build_member_set(d_vert), zero_rna(), Env())
+        s_i = assemble_statics(build_member_set(d_incl), zero_rna(), Env())
+        np.testing.assert_allclose(s_v.V, np.pi / 4 * 16 * 40, rtol=1e-9)
+        np.testing.assert_allclose(s_i.V, s_v.V, rtol=1e-6)
+        np.testing.assert_allclose(s_i.mass, s_v.mass, rtol=1e-9)
+
+
+class TestOrientationCanonicalization:
+    def test_deck_down_member_matches_deck_up(self):
+        # a surface-piercing member listed top-first must give identical
+        # hydrostatics (regression: LWP blow-up via cosPhi clipping)
+        base = {"name": "c", "type": 2, "shape": "circ", "d": 6.5, "t": 0.03}
+        up = {"platform": {"members": [dict(base, rA=[0, 0, -30], rB=[0, 0, 10], stations=[0, 40])]}}
+        dn = {"platform": {"members": [dict(base, rA=[0, 0, 10], rB=[0, 0, -30], stations=[0, 40])]}}
+        s_up = assemble_statics(build_member_set(up), zero_rna(), Env())
+        s_dn = assemble_statics(build_member_set(dn), zero_rna(), Env())
+        np.testing.assert_allclose(s_dn.V, s_up.V, rtol=1e-9)
+        np.testing.assert_allclose(s_dn.C_hydro, s_up.C_hydro, rtol=1e-9, atol=1e-6)
+        np.testing.assert_allclose(s_dn.W_hydro, s_up.W_hydro, rtol=1e-9, atol=1e-6)
+
+
+class TestRectangular:
+    def test_single_pair_two_stations(self):
+        # a 1-D [len, wid] spec must mean one cross-section pair even with
+        # exactly two stations (regression: was parsed as two square sections)
+        des = {
+            "platform": {
+                "members": [
+                    {
+                        "name": "box", "type": 2, "shape": "rect",
+                        "rA": [0, 0, -20], "rB": [0, 0, 0],
+                        "stations": [0, 20], "d": [4.0, 2.0], "t": 0.05,
+                        "rho_shell": 8000.0,
+                    }
+                ]
+            },
+        }
+        stat = assemble_statics(build_member_set(des), zero_rna(), Env())
+        np.testing.assert_allclose(stat.V, 4.0 * 2.0 * 20.0, rtol=1e-9)
+        v_shell = 4 * 2 * 20 - (4 - 0.1) * (2 - 0.1) * 20
+        np.testing.assert_allclose(stat.mass, 8000.0 * v_shell, rtol=1e-9)
+
+
+class TestCaps:
+    def test_solid_bottom_cap_mass(self):
+        des = cylinder_design(10.0, 0.05, -80.0, 20.0)
+        mem = des["platform"]["members"][0]
+        mem["cap_stations"] = [-80.0]
+        mem["cap_t"] = [0.2]
+        mem["cap_d_in"] = [0.0]
+        ms = build_member_set(des)
+        stat = assemble_statics(ms, zero_rna(), Env())
+        ms0 = build_member_set(cylinder_design(10.0, 0.05, -80.0, 20.0))
+        stat0 = assemble_statics(ms0, zero_rna(), Env())
+        di = 10.0 - 2 * 0.05
+        m_cap = 8000.0 * np.pi / 4 * di**2 * 0.2
+        np.testing.assert_allclose(float(stat.mass - stat0.mass), m_cap, rtol=1e-6)
+        # caps must not alter hydrostatics
+        np.testing.assert_allclose(stat.V, stat0.V, rtol=1e-12)
+
+
+class TestOC3Spar:
+    """Sanity checks against the public OC3-Hywind spec (loose tolerances:
+    the YAML spar is a shell+ballast approximation of the spec's lumped
+    properties)."""
+
+    def setup_method(self):
+        import os
+
+        import yaml
+
+        path = os.path.join(os.path.dirname(__file__), "..", "raft_tpu", "designs", "OC3spar.yaml")
+        with open(path) as f:
+            self.design = yaml.safe_load(f)
+        self.ms = build_member_set(self.design)
+        self.rna = build_rna(self.design)
+        self.stat = assemble_statics(self.ms, self.rna, Env(depth=320.0))
+
+    def test_displacement(self):
+        # OC3 spec platform displacement 8029.2 m^3
+        np.testing.assert_allclose(self.stat.V, 8029.2, rtol=0.02)
+
+    def test_center_of_buoyancy(self):
+        # OC3 spec CB at -62.07 m
+        np.testing.assert_allclose(self.stat.rCB[2], -62.07, rtol=0.02)
+
+    def test_waterplane_area(self):
+        np.testing.assert_allclose(self.stat.AWP, np.pi / 4 * 6.5**2, rtol=1e-6)
+
+    def test_total_mass_magnitude(self):
+        # platform 7,466,330 + tower 249,718 + RNA 350,000 ~ 8.07e6 kg
+        assert 6.5e6 < float(self.stat.mass) < 9.5e6
+
+    def test_tower_mass(self):
+        # NREL 5MW tower (OC3 variant) ~ 249,718 kg
+        np.testing.assert_allclose(self.stat.m_tower, 249718.0, rtol=0.03)
+
+    def test_heave_stiffness(self):
+        np.testing.assert_allclose(
+            self.stat.C_hydro[2, 2], RHO * G * np.pi / 4 * 6.5**2, rtol=1e-6
+        )
+
+
+class TestBatchingAndGrad:
+    def test_vmap_matches_loop(self):
+        designs = [cylinder_design(d=8.0), cylinder_design(d=12.0)]
+        sets = [build_member_set(d) for d in designs]
+        batched = jax.tree.map(lambda *xs: jnp.stack(xs), *sets)
+        rna, env = zero_rna(), Env()
+        out_b = jax.vmap(lambda m: assemble_statics(m, rna, env))(batched)
+        for i, s in enumerate(sets):
+            out_i = assemble_statics(s, rna, env)
+            np.testing.assert_allclose(out_b.V[i], out_i.V, rtol=1e-12)
+            np.testing.assert_allclose(out_b.M_struc[i], out_i.M_struc, rtol=1e-12)
+
+    def test_grad_volume_wrt_diameter(self):
+        ms = build_member_set(cylinder_design(d=10.0))
+
+        def vol(scale):
+            m2 = ms.replace(
+                seg_dA=ms.seg_dA * scale, seg_dB=ms.seg_dB * scale,
+                seg_diA=ms.seg_diA * scale, seg_diB=ms.seg_diB * scale,
+            )
+            return assemble_statics(m2, zero_rna(), Env()).V
+
+        g = jax.grad(vol)(1.0)
+        eps = 1e-5
+        fd = (vol(1.0 + eps) - vol(1.0 - eps)) / (2 * eps)
+        np.testing.assert_allclose(g, fd, rtol=1e-5)
+
+    def test_padding_invariance(self):
+        des = cylinder_design(d=10.0)
+        s1 = assemble_statics(build_member_set(des), zero_rna(), Env())
+        s2 = assemble_statics(
+            build_member_set(des, pad_segments=8, pad_nodes=40), zero_rna(), Env()
+        )
+        np.testing.assert_allclose(s1.mass, s2.mass, rtol=1e-12)
+        np.testing.assert_allclose(s1.M_struc, s2.M_struc, rtol=1e-12)
+        np.testing.assert_allclose(s1.C_hydro, s2.C_hydro, rtol=1e-12)
+        np.testing.assert_allclose(s1.V, s2.V, rtol=1e-12)

@@ -53,7 +53,13 @@ _NODE_KEYS = [
 
 
 def _orientation(rA, rB, gamma_deg):
-    """q/p1/p2 unit vectors + Z1Y2Z3 rotation matrix (cf. raft/raft.py:205-242)."""
+    """q/p1/p2 unit vectors + Z1Y2Z3 rotation matrix (cf. raft/raft.py:205-242).
+
+    float64 numpy twin of core.transforms.member_orientation — the host build
+    must stay double precision regardless of the jax x64 flag, so it cannot
+    route through jnp.  tests/test_build_members.py pins the two
+    implementations against each other so they cannot diverge.
+    """
     rAB = rB - rA
     l = np.linalg.norm(rAB)
     q = rAB / l
@@ -105,6 +111,30 @@ def _interp_pairs(x, xs, pairs):
     return np.array(
         [np.interp(x, xs, pairs[:, 0]), np.interp(x, xs, pairs[:, 1])]
     )
+
+
+def _cap_hole_pairs(d_in, ncap, circ):
+    """Normalize 'cap_d_in' to (ncap,2) hole side-length pairs.
+
+    Mirrors the `_as_pairs` convention: circular members read a 1-D list as
+    per-cap hole diameters; rectangular members read a length-2 1-D list as
+    one [len, wid] hole pair broadcast to every cap (a pair even when
+    ncap == 2), or an (ncap,2) array of per-cap pairs.
+    """
+    d_in = np.asarray(d_in, dtype=float)
+    if d_in.ndim == 0:
+        return np.tile(d_in, (ncap, 2))
+    if d_in.ndim == 1:
+        if not circ and d_in.shape[0] == 2:
+            return np.tile(d_in, (ncap, 1))
+        if d_in.shape[0] == ncap:
+            return np.stack([d_in, d_in], axis=-1)
+        if d_in.shape[0] == 1:
+            return np.tile(d_in[0], (ncap, 2))
+        raise ValueError("'cap_d_in' must be scalar, per-cap, or a rect [len,wid] pair")
+    if d_in.shape == (ncap, 2):
+        return d_in
+    raise ValueError("'cap_d_in' must be scalar, per-cap, or an (ncap,2) pair list")
 
 
 def add_member(acc: _Accum, mi: dict, member_id: int, dls_max: float = 10.0,
@@ -185,16 +215,26 @@ def add_member(acc: _Accum, mi: dict, member_id: int, dls_max: float = 10.0,
     cap_stations_raw = get_from_dict(mi, "cap_stations", shape=-1, default=[])
     cap_stations_raw = np.atleast_1d(np.asarray(cap_stations_raw, dtype=float))
     if cap_stations_raw.size:
-        cap_t = np.atleast_1d(get_from_dict(mi, "cap_t", shape=cap_stations_raw.shape[0]))
-        cap_d_in = np.asarray(get_from_dict(mi, "cap_d_in", shape=-1, default=0.0), dtype=float)
-        cap_d_in = np.broadcast_to(np.atleast_1d(cap_d_in), (cap_stations_raw.shape[0],)) \
-            if cap_d_in.ndim <= 1 else cap_d_in
+        ncap = cap_stations_raw.shape[0]
+        cap_t = np.atleast_1d(get_from_dict(mi, "cap_t", shape=ncap))
+        cap_d_in = _cap_hole_pairs(
+            np.asarray(get_from_dict(mi, "cap_d_in", shape=-1, default=0.0), dtype=float),
+            ncap, circ,
+        )
         cap_L = (cap_stations_raw - stations_raw[0]) / (stations_raw[-1] - stations_raw[0]) * l
 
         for ci in range(cap_L.shape[0]):
             L, h = cap_L[ci], cap_t[ci]
-            hole = np.atleast_1d(np.asarray(cap_d_in[ci], dtype=float))
-            hole = np.array([hole[0], hole[-1]])
+            hole = cap_d_in[ci]
+            # skip bulkheads within one thickness of either member end — the
+            # interior-cap interpolation below would reach past the end.  The
+            # reference has the same guard (raft/raft.py:504-508) but its
+            # top-end clause is always-false (`L > stations[-1] + h`, should
+            # be `- h`); the intended both-ends form is used here (DEVIATIONS.md).
+            near_A = stations[0] < L < stations[0] + h and not np.isclose(L, stations[0])
+            near_B = stations[-1] - h < L < stations[-1] and not np.isclose(L, stations[-1])
+            if near_A or near_B:
+                continue
             if np.isclose(L, stations[0]):
                 dA_c = di[0]
                 dB_c = _interp_pairs(L + h, stations, di)

@@ -87,6 +87,24 @@ def translate_matrix_6to6(r: Array, M: Array) -> Array:
     return jnp.concatenate([top, bot], axis=-2)
 
 
+def rotate_diag_tensor(R: Array, Ixx: Array, Iyy: Array, Izz: Array) -> Array:
+    """Rotate a diagonal rank-2 tensor into global axes: R diag(I) R^T.
+
+    R: (...,3,3); Ixx/Iyy/Izz: (...) -> (...,3,3).  Used for member-local
+    inertia and waterplane-inertia tensors.
+    """
+    zeros = jnp.zeros_like(Ixx)
+    I_loc = jnp.stack(
+        [
+            jnp.stack([Ixx, zeros, zeros], axis=-1),
+            jnp.stack([zeros, Iyy, zeros], axis=-1),
+            jnp.stack([zeros, zeros, Izz], axis=-1),
+        ],
+        axis=-2,
+    )
+    return R @ I_loc @ jnp.swapaxes(R, -1, -2)
+
+
 def small_rotation_displacement(r: Array, th: Array) -> Array:
     """Displacement of a point at r under small rotations th: th x r.
 

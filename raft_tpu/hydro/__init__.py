@@ -1,0 +1,8 @@
+"""Hydrodynamics: strip-theory (Morison) kernels and BEM coefficient providers."""
+from raft_tpu.hydro.strip import (  # noqa: F401
+    StripKin,
+    linearized_drag,
+    node_kinematics,
+    strip_added_mass,
+    strip_excitation,
+)

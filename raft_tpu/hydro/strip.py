@@ -1,0 +1,234 @@
+"""Strip-theory (Morison) hydrodynamics over the stacked node axis.
+
+Vectorized, jittable, differentiable equivalent of the reference's
+``FOWT.calcHydroConstants`` (raft/raft.py:2076-2157) and
+``FOWT.calcLinearizedTerms`` (raft/raft.py:2160-2264): the member/node/
+frequency triple loop becomes batched einsums over the (N nodes, nw
+frequencies) axes.  A design batch is the same call under ``vmap``.
+
+Conventions:
+  * All complex amplitudes are :class:`~raft_tpu.core.cplx.Cx` (re, im)
+    pairs; frequency is the *leading* data axis of assembled outputs,
+    i.e. excitation vectors are (nw, 6) and frequency-dependent matrices
+    (nw, 6, 6) — the layout the batched impedance solve consumes directly.
+  * A node contributes only while submerged (z < 0), matching the
+    reference's node gate at raft/raft.py:2097; here it is a mask so the
+    computation stays shape-static under jit/vmap.
+
+Deviations from the reference (correct physics kept; see DEVIATIONS.md):
+  * Drag coefficients: the reference interpolates the *added-mass* profiles
+    for use as drag coefficients (``mem.Ca_*`` at raft/raft.py:2194-2197);
+    here the actual Cd profiles are used.
+  * Rectangular axial skin-drag area: the reference computes
+    ``2*(ds[0]+ds[0])*dls`` (raft/raft.py:2207); here the perimeter uses
+    both side lengths, ``2*(ds[0]+ds[1])*dls``.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+from flax import struct
+
+from raft_tpu.core import cplx
+from raft_tpu.core.cplx import Cx
+from raft_tpu.core.transforms import translate_force_3to6, translate_matrix_3to6, vec_outer
+from raft_tpu.core.types import Env, MemberSet, WaveState
+from raft_tpu.core.waves import wave_kinematics
+
+Array = jnp.ndarray
+
+_SQRT_8_PI = (8.0 / jnp.pi) ** 0.5
+
+
+@struct.dataclass
+class StripKin:
+    """Wave kinematics at the strip nodes (precomputed once per sea state)."""
+
+    u: Cx      # (N,nw,3) water particle velocity amplitudes
+    ud: Cx     # (N,nw,3) acceleration amplitudes
+    pDyn: Cx   # (N,nw)   dynamic pressure amplitudes
+
+
+def node_kinematics(m: MemberSet, wave: WaveState, env: Env) -> StripKin:
+    """Evaluate wave kinematics at every strip node (cf. raft/raft.py:2100)."""
+    u, ud, pDyn = wave_kinematics(
+        wave.zeta, wave.w, wave.k, env.depth, m.node_r, env.beta, env.rho, env.g
+    )
+    # wave_kinematics returns (...,3,nw); put frequency before the xyz axis
+    return StripKin(u=u.swapaxes(-1, -2), ud=ud.swapaxes(-1, -2), pDyn=pDyn)
+
+
+def _submerged(m: MemberSet) -> Array:
+    return (m.node_r[..., 2] < 0.0) & m.node_mask
+
+
+def _side_volume(m: MemberSet) -> Array:
+    """Member volume assigned to each node (cf. raft/raft.py:2111-2114)."""
+    d0, d1 = m.node_ds[..., 0], m.node_ds[..., 1]
+    return jnp.where(
+        m.node_circ,
+        0.25 * jnp.pi * d0 * d0 * m.node_dls,
+        d0 * d1 * m.node_dls,
+    )
+
+
+def _end_volume(m: MemberSet) -> Array:
+    """Volume assigned to each node's end surface (cf. raft/raft.py:2135-2139)."""
+    d_c = m.node_ds[..., 0]
+    dr_c = m.node_drs[..., 0]
+    v_circ = jnp.pi / 6.0 * ((d_c + dr_c) ** 3 - (d_c - dr_c) ** 3)
+    dm = 0.5 * (m.node_ds[..., 0] + m.node_ds[..., 1])
+    drm = 0.5 * (m.node_drs[..., 0] + m.node_drs[..., 1])
+    v_rect = jnp.pi / 6.0 * ((dm + drm) ** 3 - (dm - drm) ** 3)
+    return jnp.where(m.node_circ, v_circ, v_rect)
+
+
+def _end_area_signed(m: MemberSet) -> Array:
+    """Signed end area, positive facing -q (cf. raft/raft.py:2136-2140)."""
+    a_circ = jnp.pi * m.node_ds[..., 0] * m.node_drs[..., 0]
+    a_rect = (m.node_ds[..., 0] + m.node_drs[..., 0]) * (m.node_ds[..., 1] + m.node_drs[..., 1]) - (
+        m.node_ds[..., 0] - m.node_drs[..., 0]
+    ) * (m.node_ds[..., 1] - m.node_drs[..., 1])
+    return jnp.where(m.node_circ, a_circ, a_rect)
+
+
+def _direction_mats(m: MemberSet):
+    """Outer-product direction matrices qq/p1p1/p2p2 per node: (N,3,3)."""
+    return vec_outer(m.node_q), vec_outer(m.node_p1), vec_outer(m.node_p2)
+
+
+def strip_added_mass(m: MemberSet, env: Env) -> Array:
+    """Morison added-mass matrix A (6,6) about the PRP.
+
+    Side (transverse + axial) plus end effects, summed over submerged nodes
+    (cf. raft/raft.py:2110-2148).
+    """
+    qq, p1p1, p2p2 = _direction_mats(m)
+    v_side = _side_volume(m)
+    v_end = _end_volume(m)
+    Amat = env.rho * (
+        v_side[..., None, None]
+        * (
+            m.node_Ca_q[..., None, None] * qq
+            + m.node_Ca_p1[..., None, None] * p1p1
+            + m.node_Ca_p2[..., None, None] * p2p2
+        )
+        + (v_end * m.node_Ca_end)[..., None, None] * qq
+    )
+    w = _submerged(m).astype(Amat.dtype)
+    A6 = translate_matrix_3to6(m.node_r, Amat) * w[..., None, None]
+    return A6.sum(axis=-3)
+
+
+def _translate_force_cx(r: Array, F: Cx) -> Cx:
+    """Complex force at points r -> 6-DOF force about origin.
+
+    r: (N,3); F: Cx (N,nw,3) -> Cx (N,nw,6).
+    """
+    rb = r[..., None, :]
+    return Cx(translate_force_3to6(rb, F.re), translate_force_3to6(rb, F.im))
+
+
+def strip_excitation(m: MemberSet, kin: StripKin, env: Env) -> Cx:
+    """Froude-Krylov + dynamic-pressure excitation F (nw,6), complex.
+
+    Side inertial term Imat @ ud plus end inertial + dynamic-pressure terms
+    (cf. raft/raft.py:2120-2161).  Above-water nodes contribute zero because
+    the wave kinematics are masked there.
+    """
+    qq, p1p1, p2p2 = _direction_mats(m)
+    v_side = _side_volume(m)
+    v_end = _end_volume(m)
+    Imat = env.rho * (
+        v_side[..., None, None]
+        * (
+            (1.0 + m.node_Ca_q)[..., None, None] * qq
+            + (1.0 + m.node_Ca_p1)[..., None, None] * p1p1
+            + (1.0 + m.node_Ca_p2)[..., None, None] * p2p2
+        )
+        + (v_end * (1.0 + m.node_Ca_end))[..., None, None] * qq
+    )
+    F3 = cplx.einsum("...nij,...nwj->...nwi", Imat, kin.ud)
+    # dynamic-pressure end load: pDyn * rho * a_end * q  (raft/raft.py:2156)
+    pa = (env.rho * _end_area_signed(m))[..., None]            # (N,1)
+    Fp = Cx(
+        kin.pDyn.re * pa, kin.pDyn.im * pa
+    )                                                           # (N,nw)
+    F3 = F3 + Cx(
+        Fp.re[..., None] * m.node_q[..., None, :],
+        Fp.im[..., None] * m.node_q[..., None, :],
+    )
+    w = _submerged(m).astype(F3.re.dtype)[..., None, None]
+    F6 = _translate_force_cx(m.node_r, F3)
+    F6 = Cx(F6.re * w, F6.im * w)
+    return F6.sum(axis=-3)                                      # (nw,6)
+
+
+def node_motion(m: MemberSet, Xi: Cx, w: Array) -> Cx:
+    """Node velocity amplitudes from rigid-body response Xi.
+
+    Xi: Cx (nw,6) platform response; returns Cx (N,nw,3) velocities
+    v = i w (Xi_t + Xi_r x r)  (cf. getVelocity, raft/raft.py:903-919).
+    """
+    r = m.node_r[..., None, :]                                  # (N,1,3)
+
+    def disp(x):
+        xt = x[..., :3]                                         # (nw,3)
+        xr = x[..., 3:]
+        return xt + jnp.cross(jnp.broadcast_to(xr, jnp.broadcast_shapes(xr.shape, r.shape)), r)
+
+    dr = Cx(disp(Xi.re), disp(Xi.im))                           # (N,nw,3)
+    return Cx(dr.re * w[:, None], dr.im * w[:, None]).mul_i()
+
+
+def linearized_drag(
+    m: MemberSet, kin: StripKin, Xi: Cx, wave: WaveState, env: Env
+) -> tuple[Array, Cx]:
+    """Stochastically linearized Morison drag about the response iterate Xi.
+
+    Borgman linearization: B' = sqrt(8/pi) * vRMS * 0.5 rho a Cd per node
+    per direction (cf. raft/raft.py:2160-2264).  The per-direction vRMS uses
+    the reference's component-weighted convention: the relative-velocity
+    spectrum is multiplied elementwise by the direction unit vector and the
+    Frobenius norm is taken over (xyz, frequency) (raft/raft.py:2219-2227).
+
+    Returns (B_drag (6,6) real damping, F_drag Cx (nw,6) drag excitation).
+    """
+    vnode = node_motion(m, Xi, wave.w)                          # (N,nw,3)
+    vrel = kin.u - vnode
+
+    def vrms(unit):                                             # unit: (N,3)
+        w2 = unit[..., None, :] ** 2                            # (N,1,3)
+        s = ((vrel.re**2 + vrel.im**2) * w2).sum(axis=(-1, -2))
+        return jnp.sqrt(s)                                      # (N,)
+
+    vRMS_q = vrms(m.node_q)
+    vRMS_p1 = vrms(m.node_p1)
+    vRMS_p2 = vrms(m.node_p2)
+
+    d0, d1 = m.node_ds[..., 0], m.node_ds[..., 1]
+    dls = m.node_dls
+    a_q = jnp.where(m.node_circ, jnp.pi * d0 * dls, 2.0 * (d0 + d1) * dls)
+    a_p1 = jnp.where(m.node_circ, d0 * dls, d0 * dls)
+    a_p2 = jnp.where(m.node_circ, d0 * dls, d1 * dls)
+    a_end = jnp.abs(_end_area_signed(m))
+
+    half_rho = 0.5 * env.rho
+    Bq = _SQRT_8_PI * vRMS_q * half_rho * a_q * m.node_Cd_q
+    Bp1 = _SQRT_8_PI * vRMS_p1 * half_rho * a_p1 * m.node_Cd_p1
+    Bp2 = _SQRT_8_PI * vRMS_p2 * half_rho * a_p2 * m.node_Cd_p2
+    Bend = _SQRT_8_PI * vRMS_q * half_rho * a_end * m.node_Cd_end
+
+    qq, p1p1, p2p2 = _direction_mats(m)
+    Bmat = (
+        (Bq + Bend)[..., None, None] * qq
+        + Bp1[..., None, None] * p1p1
+        + Bp2[..., None, None] * p2p2
+    )
+    Bmat = Bmat * _submerged(m).astype(Bmat.dtype)[..., None, None]
+
+    B6 = translate_matrix_3to6(m.node_r, Bmat).sum(axis=-3)
+
+    # drag excitation uses the undisturbed wave velocity (raft/raft.py:2238)
+    F3 = cplx.einsum("...nij,...nwj->...nwi", Bmat, kin.u)
+    F6 = _translate_force_cx(m.node_r, F3).sum(axis=-3)         # (nw,6)
+    return B6, F6

@@ -22,7 +22,11 @@ from __future__ import annotations
 import jax.numpy as jnp
 
 from raft_tpu.core.frustum import frustum_moi, frustum_vcv
-from raft_tpu.core.transforms import translate_force_3to6, translate_matrix_6to6
+from raft_tpu.core.transforms import (
+    rotate_diag_tensor,
+    translate_force_3to6,
+    translate_matrix_6to6,
+)
 from raft_tpu.core.types import Env, MemberSet, RigidBodyCoeffs, RNA
 
 Array = jnp.ndarray
@@ -67,16 +71,7 @@ def segment_inertia(m: MemberSet):
     Izz = Izz_o - Izz_i + Izz_f
 
     # rotate the local MOI tensor into global axes: I' = R I R^T
-    zeros = jnp.zeros_like(Ixx)
-    I_loc = jnp.stack(
-        [
-            jnp.stack([Ixx, zeros, zeros], axis=-1),
-            jnp.stack([zeros, Iyy, zeros], axis=-1),
-            jnp.stack([zeros, zeros, Izz], axis=-1),
-        ],
-        axis=-2,
-    )
-    I_rot = m.seg_R @ I_loc @ jnp.swapaxes(m.seg_R, -1, -2)
+    I_rot = rotate_diag_tensor(m.seg_R, Ixx, Iyy, Izz)
 
     M6 = jnp.zeros((*mass.shape, 6, 6), dtype=mass.dtype)
     eye3 = jnp.eye(3, dtype=mass.dtype)
@@ -112,7 +107,12 @@ def segment_hydrostatics(m: MemberSet, env: Env):
     zA = rA_s[..., 2]
     zB = rB_s[..., 2]
     live = m.seg_mask & ~m.seg_is_cap
-    crossing = (zA * zB <= 0.0) & live
+    # strict zA < 0 so a station exactly at the waterline assigns the plane
+    # crossing to the lower segment only — summing per-segment waterplane
+    # terms would otherwise double-count AWP/C33 when a design places a
+    # station at z=0 (the reference overwrites member-level AWP instead of
+    # summing, so it cannot hit this)
+    crossing = (zA < 0.0) & (zB >= 0.0) & live
     submerged = (zA <= 0.0) & (zB <= 0.0) & ~crossing & live
 
     cosPhi = jnp.clip(qv[..., 2], _EPS, None)
@@ -134,16 +134,7 @@ def segment_hydrostatics(m: MemberSet, env: Env):
     # (cf. raft/raft.py:705-709); circular sections are isotropic, and the
     # reference's vertical-waterplane assumption (raft/raft.py:713) applies,
     # so they are left unrotated.
-    zeros = jnp.zeros_like(IxWP_rect)
-    I_loc = jnp.stack(
-        [
-            jnp.stack([IxWP_rect, zeros, zeros], axis=-1),
-            jnp.stack([zeros, IyWP_rect, zeros], axis=-1),
-            jnp.stack([zeros, zeros, zeros], axis=-1),
-        ],
-        axis=-2,
-    )
-    I_rot = m.seg_R @ I_loc @ jnp.swapaxes(m.seg_R, -1, -2)
+    I_rot = rotate_diag_tensor(m.seg_R, IxWP_rect, IyWP_rect, jnp.zeros_like(IxWP_rect))
     IWP_circ = jnp.pi / 64.0 * (dWP[..., 0] * dWP[..., 1]) ** 2
     IxWP = jnp.where(m.seg_circ, IWP_circ, I_rot[..., 0, 0])
     IyWP = jnp.where(m.seg_circ, IWP_circ, I_rot[..., 1, 1])

@@ -1,0 +1,117 @@
+"""Member-builder tests: orientation parity and cap/bulkhead edge cases."""
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from raft_tpu.build.members import _orientation, build_member_set
+from raft_tpu.core.transforms import member_orientation
+
+
+def test_orientation_numpy_jnp_parity():
+    """The host (numpy) and device (jnp) orientation code must agree exactly."""
+    rng = np.random.default_rng(42)
+    for _ in range(20):
+        rA = rng.standard_normal(3) * 30
+        rB = rA + rng.standard_normal(3) * 20
+        gamma = float(rng.uniform(-180, 180))
+        q_np, p1_np, p2_np, R_np = _orientation(rA, rB, gamma)
+        q_j, p1_j, p2_j, R_j = member_orientation(
+            jnp.asarray(rA), jnp.asarray(rB), jnp.deg2rad(gamma)
+        )
+        np.testing.assert_allclose(q_np, np.asarray(q_j), atol=1e-12)
+        np.testing.assert_allclose(p1_np, np.asarray(p1_j), atol=1e-12)
+        np.testing.assert_allclose(p2_np, np.asarray(p2_j), atol=1e-12)
+        np.testing.assert_allclose(R_np, np.asarray(R_j), atol=1e-12)
+
+
+def _spar(cap_stations, cap_t):
+    return {
+        "platform": {
+            "members": [
+                {
+                    "name": "spar",
+                    "type": 2,
+                    "rA": [0, 0, -90.0],
+                    "rB": [0, 0, 10.0],
+                    "shape": "circ",
+                    "stations": [-90, 10],
+                    "d": 9.0,
+                    "t": 0.05,
+                    "cap_stations": cap_stations,
+                    "cap_t": cap_t,
+                    "cap_d_in": [0.0] * len(cap_stations),
+                }
+            ]
+        },
+    }
+
+
+def test_near_end_bulkhead_skipped():
+    # bulkhead 0.1 m above the bottom with 0.5 m thickness -> interior-cap
+    # interpolation would reach past end A; must be skipped (DEVIATIONS.md #9)
+    ms_near = build_member_set(_spar([-89.9], [0.5]))
+    ms_none = build_member_set(_spar([], []))
+    n_caps_near = int(np.asarray(ms_near.seg_is_cap & ms_near.seg_mask).sum())
+    n_caps_none = int(np.asarray(ms_none.seg_is_cap & ms_none.seg_mask).sum())
+    assert n_caps_near == n_caps_none == 0
+
+    # near the top end likewise (the reference's always-false clause)
+    ms_top = build_member_set(_spar([9.9], [0.5]))
+    assert int(np.asarray(ms_top.seg_is_cap & ms_top.seg_mask).sum()) == 0
+
+
+def test_end_and_interior_caps_kept():
+    ms = build_member_set(_spar([-90.0, -50.0, 10.0], [0.5, 0.5, 0.5]))
+    assert int(np.asarray(ms.seg_is_cap & ms.seg_mask).sum()) == 3
+
+
+def test_cap_hole_pair_conventions():
+    from raft_tpu.build.members import _cap_hole_pairs
+
+    # rect: a [len,wid] pair broadcasts to all caps, even when ncap == 2
+    np.testing.assert_array_equal(
+        _cap_hole_pairs(np.array([2.0, 1.0]), 2, circ=False),
+        [[2.0, 1.0], [2.0, 1.0]],
+    )
+    # rect single cap with a pair hole must not crash
+    np.testing.assert_array_equal(
+        _cap_hole_pairs(np.array([2.0, 1.0]), 1, circ=False), [[2.0, 1.0]]
+    )
+    # circ: per-cap hole diameters
+    np.testing.assert_array_equal(
+        _cap_hole_pairs(np.array([2.0, 1.0]), 2, circ=True), [[2.0, 2.0], [1.0, 1.0]]
+    )
+    np.testing.assert_array_equal(_cap_hole_pairs(np.array(3.0), 2, circ=True),
+                                  [[3.0, 3.0], [3.0, 3.0]])
+    with pytest.raises(ValueError):
+        _cap_hole_pairs(np.array([1.0, 2.0, 3.0]), 2, circ=True)
+
+
+def test_waterline_station_no_double_count():
+    """A station exactly at z=0 must not double-count waterplane terms."""
+    import jax
+    from raft_tpu.core.types import Env, RNA
+    from raft_tpu.statics import assemble_statics
+
+    def spar(stations):
+        return {
+            "platform": {
+                "members": [
+                    {
+                        "name": "cyl", "type": 2,
+                        "rA": [0, 0, -80.0], "rB": [0, 0, 20.0],
+                        "shape": "circ", "stations": stations,
+                        "d": 10.0, "t": 0.05,
+                    }
+                ]
+            },
+        }
+
+    rna = RNA(mRNA=0.0, IxRNA=0.0, IrRNA=0.0, xCG_RNA=0.0, hHub=0.0)
+    s1 = jax.jit(assemble_statics)(build_member_set(spar([-80, 20])), rna, Env())
+    s2 = jax.jit(assemble_statics)(build_member_set(spar([-80, 0, 20])), rna, Env())
+    np.testing.assert_allclose(np.asarray(s2.AWP), np.asarray(s1.AWP), rtol=1e-9)
+    np.testing.assert_allclose(
+        np.asarray(s2.C_hydro), np.asarray(s1.C_hydro), rtol=1e-9, atol=1e-3
+    )
+    np.testing.assert_allclose(np.asarray(s2.V), np.asarray(s1.V), rtol=1e-9)

@@ -1,0 +1,143 @@
+"""Benchmark: batched design x frequency RAO solves per second per chip.
+
+Workload (the BASELINE.json north star): a batch of OC3-spar geometry
+variants, each solved on a 200-bin frequency grid through the full
+drag-linearized RAO fixed point, on one TPU chip.  The baseline is the
+reference-style serial NumPy path (per-node Python loop drag linearization +
+per-frequency 6x6 solve, the structure of raft/raft.py:1497-1552 and
+:2160-2264) measured on this host — the reference publishes no numbers
+(BASELINE.md), so the comparison is measured-vs-measured on identical physics.
+
+Prints exactly one JSON line:
+  {"metric": "design-freq RAO solves/sec/chip", "value": ..., "unit": "solves/s", "vs_baseline": ...}
+"""
+from __future__ import annotations
+
+import json
+import time
+
+import numpy as np
+
+
+def tpu_throughput(batch: int = 256, nw: int = 200, reps: int = 5):
+    import jax
+    import jax.numpy as jnp
+
+    import __graft_entry__ as ge
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+
+    design, members, rna, env, wave = ge._base(nw=nw)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+
+    fwd = jax.jit(
+        jax.vmap(lambda s: ge._forward(members, rna, env, wave, C_moor, s).abs2())
+    )
+    scales = jnp.linspace(0.9, 1.1, batch)
+    out = fwd(scales)
+    out.block_until_ready()                       # compile + warm cache
+    best = np.inf
+    for _ in range(reps):
+        t0 = time.perf_counter()
+        fwd(scales).block_until_ready()
+        best = min(best, time.perf_counter() - t0)
+    return batch * nw / best
+
+
+def numpy_baseline(nw: int = 200, n_iter: int = 15):
+    """Reference-style serial path: one design, same grid, fixed iterations."""
+    import jax.numpy as jnp
+
+    import __graft_entry__ as ge
+    from raft_tpu.hydro import node_kinematics, strip_added_mass, strip_excitation
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+    from raft_tpu.statics import assemble_statics
+
+    design, members, rna, env, wave = ge._base(nw=nw)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = np.asarray(mooring_stiffness(moor, jnp.zeros(6)))
+    stat = assemble_statics(members, rna, env)
+    kin = node_kinematics(members, wave, env)
+    A = np.asarray(strip_added_mass(members, env))
+    F0 = np.asarray(strip_excitation(members, kin, env).to_complex())
+    M = np.asarray(stat.M_struc) + A
+    C = np.asarray(stat.C_struc) + np.asarray(stat.C_hydro) + C_moor
+
+    w = np.asarray(wave.w)
+    u = np.asarray(kin.u.to_complex())            # (N,nw,3)
+    mask = np.asarray((members.node_r[:, 2] < 0) & members.node_mask)
+    r = np.asarray(members.node_r)
+    q, p1, p2 = (np.asarray(x) for x in (members.node_q, members.node_p1, members.node_p2))
+    ds, drs, dls = (np.asarray(x) for x in (members.node_ds, members.node_drs, members.node_dls))
+    circ = np.asarray(members.node_circ)
+    Cd = {k: np.asarray(getattr(members, f"node_Cd_{k}")) for k in ("q", "p1", "p2", "end")}
+    rho = float(env.rho)
+    c_sqrt = np.sqrt(8.0 / np.pi)
+
+    def get_h(rv):
+        return np.array([[0, -rv[2], rv[1]], [rv[2], 0, -rv[0]], [-rv[1], rv[0], 0]])
+
+    Xi = np.full((nw, 6), 0.1 + 0j)
+    t0 = time.perf_counter()
+    for _ in range(n_iter):
+        B6 = np.zeros((6, 6))
+        Fd = np.zeros((nw, 6), dtype=complex)
+        for i in range(len(dls)):                 # serial per-node loop
+            if not mask[i]:
+                continue
+            H = get_h(r[i])
+            vnode = 1j * w[:, None] * (Xi[:, :3] + np.cross(Xi[:, 3:], r[i]))
+            vrel = u[i] - vnode
+            a_end = abs(
+                np.pi * ds[i, 0] * drs[i, 0]
+                if circ[i]
+                else (ds[i, 0] + drs[i, 0]) * (ds[i, 1] + drs[i, 1])
+                - (ds[i, 0] - drs[i, 0]) * (ds[i, 1] - drs[i, 1])
+            )
+            vrms_q = np.sqrt(np.sum(np.abs(vrel * q[i]) ** 2))
+            Bmat = np.zeros((3, 3))
+            for unit, ck, area in (
+                (q[i], "q", (np.pi * ds[i, 0] if circ[i] else 2 * (ds[i].sum())) * dls[i]),
+                (q[i], "end", a_end),
+                (p1[i], "p1", ds[i, 0] * dls[i]),
+                (p2[i], "p2", (ds[i, 0] if circ[i] else ds[i, 1]) * dls[i]),
+            ):
+                vrms = np.sqrt(np.sum(np.abs(vrel * unit) ** 2))
+                Bmat += (
+                    c_sqrt * vrms * 0.5 * rho * area * Cd[ck][i] * np.outer(unit, unit)
+                )
+            B6[:3, :3] += Bmat
+            B6[:3, 3:] += Bmat @ H.T
+            B6[3:, :3] += H @ Bmat
+            B6[3:, 3:] += H @ Bmat @ H.T
+            f3 = vrel @ Bmat.T
+            Fd[:, :3] += f3
+            Fd[:, 3:] += (H @ f3.T).T
+        for ii in range(nw):                      # serial per-frequency solve
+            Z = -(w[ii] ** 2) * M + 1j * w[ii] * B6 + C
+            Xi[ii] = np.linalg.solve(Z, F0[ii] + Fd[ii])
+    elapsed = time.perf_counter() - t0
+    return nw / elapsed                           # design-freq solves/sec
+
+
+def main():
+    value = tpu_throughput()
+    base = numpy_baseline()
+    print(
+        json.dumps(
+            {
+                "metric": "design-freq RAO solves/sec/chip",
+                "value": round(value, 1),
+                "unit": "solves/s",
+                "vs_baseline": round(value / base, 1),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -48,7 +48,7 @@ _SEG_KEYS = [
 _NODE_KEYS = [
     "r", "q", "p1", "p2", "ds", "drs", "dls",
     "Cd_q", "Cd_p1", "Cd_p2", "Cd_end", "Ca_q", "Ca_p1", "Ca_p2", "Ca_end",
-    "circ", "member",
+    "circ", "member", "potmod",
 ]
 
 
@@ -171,12 +171,20 @@ def add_member(acc: _Accum, mi: dict, member_id: int, dls_max: float = 10.0,
     l_fill = get_from_dict(mi, "l_fill", shape=-1, default=0.0)
     rho_fill = get_from_dict(mi, "rho_fill", shape=-1, default=0.0)
 
-    # hydro coefficient profiles (per station; interpolated onto nodes below)
+    # hydro coefficient profiles (per station; interpolated onto nodes below).
+    # 'Cd'/'Ca' apply to both transverse directions; the optional
+    # 'Cd_p1'/'Cd_p2'/'Ca_p1'/'Ca_p2' keys override per direction (p1 is the
+    # vertical transverse direction of a horizontal member) — needed for flat
+    # rectangular pontoons whose vertical added mass far exceeds the lateral.
     Cd_q = get_from_dict(mi, "Cd_q", shape=n, default=0.0)
     Cd_p = get_from_dict(mi, "Cd", shape=n, default=0.6)
+    Cd_p1 = get_from_dict(mi, "Cd_p1", shape=n, default=Cd_p)
+    Cd_p2 = get_from_dict(mi, "Cd_p2", shape=n, default=Cd_p)
     Cd_end = get_from_dict(mi, "CdEnd", shape=n, default=0.6)
     Ca_q = get_from_dict(mi, "Ca_q", shape=n, default=0.0)
     Ca_p = get_from_dict(mi, "Ca", shape=n, default=0.97)
+    Ca_p1 = get_from_dict(mi, "Ca_p1", shape=n, default=Ca_p)
+    Ca_p2 = get_from_dict(mi, "Ca_p2", shape=n, default=Ca_p)
     Ca_end = get_from_dict(mi, "CaEnd", shape=n, default=0.6)
 
     q, p1, p2, R = _orientation(rA, rB, gamma)
@@ -297,15 +305,16 @@ def add_member(acc: _Accum, mi: dict, member_id: int, dls_max: float = 10.0,
                                if np.ndim(drsi) else np.array([drsi, drsi]))
         acc.node["dls"].append(dlsi)
         acc.node["Cd_q"].append(np.interp(li, stations, Cd_q))
-        acc.node["Cd_p1"].append(np.interp(li, stations, Cd_p))
-        acc.node["Cd_p2"].append(np.interp(li, stations, Cd_p))
+        acc.node["Cd_p1"].append(np.interp(li, stations, Cd_p1))
+        acc.node["Cd_p2"].append(np.interp(li, stations, Cd_p2))
         acc.node["Cd_end"].append(np.interp(li, stations, Cd_end))
         acc.node["Ca_q"].append(np.interp(li, stations, Ca_q))
-        acc.node["Ca_p1"].append(np.interp(li, stations, Ca_p))
-        acc.node["Ca_p2"].append(np.interp(li, stations, Ca_p))
+        acc.node["Ca_p1"].append(np.interp(li, stations, Ca_p1))
+        acc.node["Ca_p2"].append(np.interp(li, stations, Ca_p2))
         acc.node["Ca_end"].append(np.interp(li, stations, Ca_end))
         acc.node["circ"].append(circ)
         acc.node["member"].append(member_id)
+        acc.node["potmod"].append(bool(mi.get("potMod", False)))
 
 
 def build_member_set(design: dict, dls_max: float = 10.0,
@@ -397,6 +406,7 @@ def build_member_set(design: dict, dls_max: float = 10.0,
         node_circ=node("circ", dt=bool),
         node_member=node("member", dt=np.int32, pad_val=-1),
         node_mask=node_mask,
+        node_potmod=node("potmod", dt=bool),
     )
 
 

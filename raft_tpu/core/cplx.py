@@ -52,8 +52,14 @@ class Cx:
         return self.re.dtype
 
     def to_complex(self) -> Array:
-        """Materialize as a jnp complex array (CPU/host use only)."""
-        return self.re + 1j * self.im
+        """Materialize as a complex array ON HOST (numpy).
+
+        The TPU backend has no complex dtype support, so the combine always
+        happens host-side; use ``.re``/``.im`` to stay on device.
+        """
+        import numpy as np
+
+        return np.asarray(self.re) + 1j * np.asarray(self.im)
 
     # ---- arithmetic ----
     def __add__(self, o):

@@ -111,6 +111,11 @@ class MemberSet:
     node_circ: Array       # (N,)  bool circular
     node_member: Array     # (N,)  int owning member id
     node_mask: Array       # (N,)  bool valid node (False = padding)
+    # potMod=True members take their inertial hydrodynamics from the BEM
+    # provider; their strip-theory added mass / FK excitation is gated off
+    # (drag stays strip-theory).  Optional for backward compatibility:
+    # None means "no potential-flow members".
+    node_potmod: Optional[Array] = struct.field(default=None)  # (N,) bool
 
 
 @struct.dataclass

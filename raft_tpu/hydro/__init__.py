@@ -1,4 +1,18 @@
 """Hydrodynamics: strip-theory (Morison) kernels and BEM coefficient providers."""
+from raft_tpu.hydro.bem_io import (  # noqa: F401
+    dimensionalize,
+    interp_to_grid,
+    load_wamit_coeffs,
+    read_wamit1,
+    read_wamit3,
+)
+from raft_tpu.hydro.mesh import (  # noqa: F401
+    mesh_design,
+    mesh_member,
+    mesh_volume,
+    write_gdf,
+    write_pnl,
+)
 from raft_tpu.hydro.strip import (  # noqa: F401
     StripKin,
     linearized_drag,

@@ -22,6 +22,13 @@ Deviations from the reference (correct physics kept; see DEVIATIONS.md):
   * Rectangular axial skin-drag area: the reference computes
     ``2*(ds[0]+ds[0])*dls`` (raft/raft.py:2207); here the perimeter uses
     both side lengths, ``2*(ds[0]+ds[1])*dls``.
+  * Axial Froude-Krylov: the reference includes BOTH the volume form
+    (``(1+Ca_q)`` on the side qq term, raft/raft.py:2122) AND the surface
+    form (dynamic pressure on ends/tapers, raft/raft.py:2156) of the same
+    axial FK force — Gauss's theorem makes them equal, so it is counted
+    twice (~2x heave excitation on a spar).  Here the side qq term carries
+    only the axial added-mass correction ``Ca_q``; the FK part comes from
+    the end/taper pressure terms alone.
 """
 from __future__ import annotations
 
@@ -61,6 +68,19 @@ def _submerged(m: MemberSet) -> Array:
     return (m.node_r[..., 2] < 0.0) & m.node_mask
 
 
+def _morison_active(m: MemberSet) -> Array:
+    """Submerged nodes whose inertial hydro comes from strip theory.
+
+    potMod members are served by the BEM provider instead — their strip
+    added mass / FK excitation is gated off here, while drag (which no
+    potential-flow solver provides) stays on for all members.
+    """
+    act = _submerged(m)
+    if m.node_potmod is not None:
+        act = act & ~m.node_potmod
+    return act
+
+
 def _side_volume(m: MemberSet) -> Array:
     """Member volume assigned to each node (cf. raft/raft.py:2111-2114)."""
     d0, d1 = m.node_ds[..., 0], m.node_ds[..., 1]
@@ -96,11 +116,13 @@ def _direction_mats(m: MemberSet):
     return vec_outer(m.node_q), vec_outer(m.node_p1), vec_outer(m.node_p2)
 
 
-def strip_added_mass(m: MemberSet, env: Env) -> Array:
+def strip_added_mass(m: MemberSet, env: Env, exclude_potmod: bool = False) -> Array:
     """Morison added-mass matrix A (6,6) about the PRP.
 
     Side (transverse + axial) plus end effects, summed over submerged nodes
-    (cf. raft/raft.py:2110-2148).
+    (cf. raft/raft.py:2110-2148).  With ``exclude_potmod`` (used when a BEM
+    provider supplies the potential-flow coefficients), potMod members are
+    gated out.
     """
     qq, p1p1, p2p2 = _direction_mats(m)
     v_side = _side_volume(m)
@@ -114,7 +136,8 @@ def strip_added_mass(m: MemberSet, env: Env) -> Array:
         )
         + (v_end * m.node_Ca_end)[..., None, None] * qq
     )
-    w = _submerged(m).astype(Amat.dtype)
+    act = _morison_active(m) if exclude_potmod else _submerged(m)
+    w = act.astype(Amat.dtype)
     A6 = translate_matrix_3to6(m.node_r, Amat) * w[..., None, None]
     return A6.sum(axis=-3)
 
@@ -128,7 +151,9 @@ def _translate_force_cx(r: Array, F: Cx) -> Cx:
     return Cx(translate_force_3to6(rb, F.re), translate_force_3to6(rb, F.im))
 
 
-def strip_excitation(m: MemberSet, kin: StripKin, env: Env) -> Cx:
+def strip_excitation(
+    m: MemberSet, kin: StripKin, env: Env, exclude_potmod: bool = False
+) -> Cx:
     """Froude-Krylov + dynamic-pressure excitation F (nw,6), complex.
 
     Side inertial term Imat @ ud plus end inertial + dynamic-pressure terms
@@ -141,15 +166,16 @@ def strip_excitation(m: MemberSet, kin: StripKin, env: Env) -> Cx:
     Imat = env.rho * (
         v_side[..., None, None]
         * (
-            (1.0 + m.node_Ca_q)[..., None, None] * qq
+            m.node_Ca_q[..., None, None] * qq
             + (1.0 + m.node_Ca_p1)[..., None, None] * p1p1
             + (1.0 + m.node_Ca_p2)[..., None, None] * p2p2
         )
         + (v_end * (1.0 + m.node_Ca_end))[..., None, None] * qq
     )
     F3 = cplx.einsum("...nij,...nwj->...nwi", Imat, kin.ud)
-    # dynamic-pressure end load: pDyn * rho * a_end * q  (raft/raft.py:2156)
-    pa = (env.rho * _end_area_signed(m))[..., None]            # (N,1)
+    # dynamic-pressure end load: pDyn * a_end * q (cf. raft/raft.py:2156; our
+    # pDyn already includes rho, the reference's getWaveKin pDyn does not)
+    pa = _end_area_signed(m)[..., None]                        # (N,1)
     Fp = Cx(
         kin.pDyn.re * pa, kin.pDyn.im * pa
     )                                                           # (N,nw)
@@ -157,7 +183,8 @@ def strip_excitation(m: MemberSet, kin: StripKin, env: Env) -> Cx:
         Fp.re[..., None] * m.node_q[..., None, :],
         Fp.im[..., None] * m.node_q[..., None, :],
     )
-    w = _submerged(m).astype(F3.re.dtype)[..., None, None]
+    act = _morison_active(m) if exclude_potmod else _submerged(m)
+    w = act.astype(F3.re.dtype)[..., None, None]
     F6 = _translate_force_cx(m.node_r, F3)
     F6 = Cx(F6.re * w, F6.im * w)
     return F6.sum(axis=-3)                                      # (nw,6)
@@ -199,7 +226,10 @@ def linearized_drag(
     def vrms(unit):                                             # unit: (N,3)
         w2 = unit[..., None, :] ** 2                            # (N,1,3)
         s = ((vrel.re**2 + vrel.im**2) * w2).sum(axis=(-1, -2))
-        return jnp.sqrt(s)                                      # (N,)
+        # double-where so padded nodes (s == 0 exactly) don't poison the
+        # backward pass with d(sqrt)/ds = inf at 0
+        s_safe = jnp.where(s > 0, s, 1.0)
+        return jnp.where(s > 0, jnp.sqrt(s_safe), 0.0)          # (N,)
 
     vRMS_q = vrms(m.node_q)
     vRMS_p1 = vrms(m.node_p1)
@@ -208,7 +238,7 @@ def linearized_drag(
     d0, d1 = m.node_ds[..., 0], m.node_ds[..., 1]
     dls = m.node_dls
     a_q = jnp.where(m.node_circ, jnp.pi * d0 * dls, 2.0 * (d0 + d1) * dls)
-    a_p1 = jnp.where(m.node_circ, d0 * dls, d0 * dls)
+    a_p1 = d0 * dls
     a_p2 = jnp.where(m.node_circ, d0 * dls, d1 * dls)
     a_end = jnp.abs(_end_area_signed(m))
 

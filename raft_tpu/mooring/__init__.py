@@ -1,0 +1,15 @@
+"""Quasi-static mooring: catenary lines, system equilibrium, stiffness."""
+from raft_tpu.mooring.catenary import (  # noqa: F401
+    CatenaryState,
+    LineProps,
+    solve_catenary,
+)
+from raft_tpu.mooring.system import (  # noqa: F401
+    MooringSystem,
+    fairlead_positions,
+    line_states,
+    mooring_force,
+    mooring_stiffness,
+    parse_mooring,
+    solve_equilibrium,
+)

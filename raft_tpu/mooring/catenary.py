@@ -1,0 +1,140 @@
+"""Analytic elastic catenary with seabed contact — the quasi-static line model.
+
+TPU-native replacement for the catenary kernel of MoorPy (external dep of the
+reference, used via ``ms.solveEquilibrium3``/``getCoupledStiffness`` at
+raft/raft.py:1343-1355).  Solves for the fairlead tension components (H, V)
+of a single mooring line given its horizontal/vertical end-to-end spans, by a
+fixed-iteration damped Newton on the closed-form elastic catenary equations
+(the MAP/Jonkman formulation):
+
+Fully suspended (vertical anchor tension V - wL >= 0):
+  xf = (H/w)[asinh(V/H) - asinh((V-wL)/H)] + H L/EA
+  zf = (H/w)[sqrt(1+(V/H)^2) - sqrt(1+((V-wL)/H)^2)] + (V L - w L^2/2)/EA
+
+Seabed contact (V < wL; resting length LB = L - V/w, zero seabed friction):
+  xf = L - V/w + (H/w) asinh(V/H) + H L/EA
+  zf = (H/w)[sqrt(1+(V/H)^2) - 1] + V^2/(2 EA w)
+
+The branch is selected per-iteration with ``jnp.where`` so the whole solve is
+shape-static, vmappable over a line batch, and differentiable (fixed Newton
+iteration count; gradients flow through the converged iterates).
+
+Deviation from MoorPy noted in DEVIATIONS.md: seabed friction coefficient CB
+is treated as zero.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from flax import struct
+
+Array = jnp.ndarray
+
+_H_MIN = 1e-6
+
+
+@struct.dataclass
+class LineProps:
+    """Per-line scalar properties (batch with a leading axis)."""
+
+    L: Array      # unstretched length [m]
+    w: Array      # submerged weight per unit length [N/m]
+    EA: Array     # axial stiffness [N]
+
+
+@struct.dataclass
+class CatenaryState:
+    H: Array          # horizontal fairlead tension [N]
+    V: Array          # vertical fairlead tension [N]
+    Ta: Array         # anchor tension magnitude [N]
+    Tf: Array         # fairlead tension magnitude [N]
+    LB: Array         # line length resting on the seabed [m]
+    residual: Array   # max |residual| of the catenary equations [m]
+
+
+def _profile_residual(H: Array, V: Array, xf: Array, zf: Array, p: LineProps):
+    """Residuals (x_model - xf, z_model - zf) with the seabed/suspended branch
+    chosen by the current V."""
+    w, L, EA = p.w, p.L, p.EA
+    Va = V - w * L                      # vertical tension at the anchor
+    touchdown = Va < 0.0
+
+    s_f = V / H
+    s_a = Va / H
+    sq_f = jnp.sqrt(1.0 + s_f * s_f)
+    sq_a = jnp.sqrt(1.0 + s_a * s_a)
+
+    x_susp = (H / w) * (jnp.arcsinh(s_f) - jnp.arcsinh(s_a)) + H * L / EA
+    z_susp = (H / w) * (sq_f - sq_a) + (V * L - 0.5 * w * L * L) / EA
+
+    LB = jnp.clip(L - V / w, 0.0, None)
+    x_td = LB + (H / w) * jnp.arcsinh(s_f) + H * L / EA
+    z_td = (H / w) * (sq_f - 1.0) + V * V / (2.0 * EA * w)
+
+    rx = jnp.where(touchdown, x_td, x_susp) - xf
+    rz = jnp.where(touchdown, z_td, z_susp) - zf
+    return rx, rz
+
+
+def initial_guess(xf: Array, zf: Array, p: LineProps):
+    """MAP-style starting point for (H, V) (Jonkman 2009, App. B)."""
+    L, w = p.L, p.w
+    slack = L * L > xf * xf + zf * zf
+    arg = jnp.clip((L * L - zf * zf) / jnp.clip(xf * xf, 1e-12, None) - 1.0, 1e-6, None)
+    lam = jnp.where(slack, jnp.sqrt(3.0 * arg), 0.2)
+    lam = jnp.where(xf <= 1e-6, 1000.0, lam)
+    H0 = jnp.clip(jnp.abs(0.5 * w * xf / lam), 10.0, None)
+    V0 = 0.5 * w * (zf / jnp.tanh(lam) + L)
+    return H0, V0
+
+
+def solve_catenary(
+    xf: Array, zf: Array, p: LineProps, iters: int = 60
+) -> CatenaryState:
+    """Solve the catenary equations for (H, V) by damped Newton.
+
+    All arguments broadcast; a batch of lines is solved in one fused kernel.
+    The 2x2 Newton system is inverted in closed form; steps are clamped to a
+    trust factor of the current iterate to keep early iterations stable.
+    """
+    H0, V0 = initial_guess(xf, zf, p)
+
+    def body(carry, _):
+        H, V = carry
+        rx, rz = _profile_residual(H, V, xf, zf, p)
+        (drx_dH, drx_dV), (drz_dH, drz_dV) = _jac(H, V, xf, zf, p)
+        det = drx_dH * drz_dV - drx_dV * drz_dH
+        det = jnp.where(jnp.abs(det) > 1e-30, det, 1e-30)
+        # closed-form 2x2 solve: [dH dV] = -J^-1 r
+        dH = (-rx * drz_dV + rz * drx_dV) / det
+        dV = (-rz * drx_dH + rx * drz_dH) / det
+        # damp: limit the step to 50% of the current magnitude (+ floor)
+        capH = 0.5 * jnp.abs(H) + 1.0
+        capV = 0.5 * jnp.abs(V) + 1.0
+        dH = jnp.clip(dH, -capH, capH)
+        dV = jnp.clip(dV, -capV, capV)
+        H_new = jnp.clip(H + dH, _H_MIN, None)
+        V_new = V + dV
+        return (H_new, V_new), None
+
+    (H, V), _ = jax.lax.scan(body, (H0, V0), None, length=iters)
+    rx, rz = _profile_residual(H, V, xf, zf, p)
+    Va = jnp.clip(V - p.w * p.L, 0.0, None)
+    LB = jnp.clip(p.L - V / p.w, 0.0, None)
+    return CatenaryState(
+        H=H,
+        V=V,
+        Ta=jnp.sqrt(H * H + Va * Va),
+        Tf=jnp.sqrt(H * H + V * V),
+        LB=LB,
+        residual=jnp.maximum(jnp.abs(rx), jnp.abs(rz)),
+    )
+
+
+def _jac(H, V, xf, zf, p):
+    """Analytic-free Jacobian of the residuals via forward-mode autodiff."""
+    fH = lambda h: jnp.stack(_profile_residual(h, V, xf, zf, p))
+    fV = lambda v: jnp.stack(_profile_residual(H, v, xf, zf, p))
+    dH = jax.jvp(fH, (H,), (jnp.ones_like(H),))[1]
+    dV = jax.jvp(fV, (V,), (jnp.ones_like(V),))[1]
+    return (dH[0], dV[0]), (dH[1], dV[1])

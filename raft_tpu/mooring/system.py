@@ -1,0 +1,178 @@
+"""Quasi-static mooring system: parse, equilibrium, linearized stiffness.
+
+TPU-native replacement for the MoorPy surface the reference consumes
+(raft/raft.py:1256-1355): ``System.parseYAML`` -> :func:`parse_mooring`;
+``solveEquilibrium3`` -> :func:`solve_equilibrium`; ``getCoupledStiffness`` /
+``getForces(lines_only=True)`` -> :func:`mooring_stiffness` /
+:func:`mooring_force`.
+
+Design: the mooring system is a pytree of stacked line arrays
+(:class:`MooringSystem`).  Every quantity is a pure function of the 6-DOF
+platform displacement ``r6``; the linearized stiffness is simply
+``-jax.jacfwd`` of the restoring force — strictly more capable than the
+reference's finite-difference-free MoorPy call because it is exact and
+differentiable end-to-end (the route to `jax.grad` co-design through the
+mooring system).
+
+The body restoring (hydrostatics + gravity) used during equilibrium is the
+linearized set assembled by :mod:`raft_tpu.statics`, matching the data the
+reference pushes into the MoorPy body at raft/raft.py:2007-2011.
+"""
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from flax import struct
+
+from raft_tpu.core.linalg6 import solve_re
+from raft_tpu.mooring.catenary import CatenaryState, LineProps, solve_catenary
+
+Array = jnp.ndarray
+
+
+@struct.dataclass
+class MooringSystem:
+    """Stacked single-body mooring system (nl lines, vessel<->anchor)."""
+
+    r_anchor: Array      # (nl,3) anchor positions, global frame
+    r_fair_body: Array   # (nl,3) fairlead positions in the body frame
+    props: LineProps     # per-line L/w/EA, each (nl,)
+    depth: Array         # () water depth [m]
+    yaw_stiffness: Array = struct.field(default=0.0)  # additive C[5,5] (raft/raft.py:1264-1268)
+
+
+def parse_mooring(mooring: dict, rho: float = 1025.0, g: float = 9.81,
+                  yaw_stiffness: float = 0.0) -> MooringSystem:
+    """Build a :class:`MooringSystem` from the design-YAML ``mooring`` dict.
+
+    Schema (cf. the reference design files, e.g. raft/OC3spar.yaml:80-147):
+    ``points`` (type fixed|vessel), ``lines`` (endA/endB point names, type,
+    length), ``line_types`` (diameter, mass_density, stiffness).  The
+    submerged weight uses the volume-equivalent diameter convention:
+    w = g (m_lin - rho pi/4 d^2).
+    """
+    pts = {p["name"]: p for p in mooring["points"]}
+    types = {t["name"]: t for t in mooring["line_types"]}
+    anchors, fairs, Ls, ws, EAs = [], [], [], [], []
+    for ln in mooring["lines"]:
+        a, b = pts[ln["endA"]], pts[ln["endB"]]
+        if a["type"] == "vessel":                 # normalize: A = anchor side
+            a, b = b, a
+        if a["type"] != "fixed" or b["type"] != "vessel":
+            raise ValueError(
+                f"line {ln['name']}: only fixed<->vessel lines are supported"
+            )
+        t = types[ln["type"]]
+        anchors.append(a["location"])
+        fairs.append(b["location"])
+        Ls.append(ln["length"])
+        m_lin = float(t["mass_density"])
+        d = float(t["diameter"])
+        ws.append(g * (m_lin - rho * np.pi / 4.0 * d * d))
+        EAs.append(float(t["stiffness"]))
+    return MooringSystem(
+        r_anchor=jnp.asarray(np.array(anchors, dtype=float)),
+        r_fair_body=jnp.asarray(np.array(fairs, dtype=float)),
+        props=LineProps(
+            L=jnp.asarray(Ls, dtype=float),
+            w=jnp.asarray(ws, dtype=float),
+            EA=jnp.asarray(EAs, dtype=float),
+        ),
+        depth=jnp.asarray(float(mooring.get("water_depth", 300.0))),
+        yaw_stiffness=jnp.asarray(float(yaw_stiffness)),
+    )
+
+
+def _rotation(r6: Array) -> Array:
+    """Roll-pitch-yaw rotation matrix R = Rz(yaw) Ry(pitch) Rx(roll)."""
+    cr, sr = jnp.cos(r6[3]), jnp.sin(r6[3])
+    cp, sp = jnp.cos(r6[4]), jnp.sin(r6[4])
+    cy, sy = jnp.cos(r6[5]), jnp.sin(r6[5])
+    Rx = jnp.array([[1.0, 0.0, 0.0], [0.0, cr, -sr], [0.0, sr, cr]])
+    Ry = jnp.array([[cp, 0.0, sp], [0.0, 1.0, 0.0], [-sp, 0.0, cp]])
+    Rz = jnp.array([[cy, -sy, 0.0], [sy, cy, 0.0], [0.0, 0.0, 1.0]])
+    return Rz @ Ry @ Rx
+
+
+def fairlead_positions(sys: MooringSystem, r6: Array) -> Array:
+    """Global fairlead positions for platform displacement r6 (nl,3)."""
+    R = _rotation(r6)
+    return r6[:3] + sys.r_fair_body @ R.T
+
+
+def line_states(sys: MooringSystem, r6: Array) -> CatenaryState:
+    """Solve every line's catenary at the given platform displacement."""
+    rf = fairlead_positions(sys, r6)
+    dxy = rf[:, :2] - sys.r_anchor[:, :2]
+    xf = jnp.sqrt(jnp.sum(dxy * dxy, axis=-1) + 1e-12)
+    zf = rf[:, 2] - sys.r_anchor[:, 2]
+    return solve_catenary(xf, zf, sys.props)
+
+
+def mooring_force(sys: MooringSystem, r6: Array) -> Array:
+    """Net 6-DOF mooring load on the platform at displacement r6.
+
+    Equivalent of MoorPy ``getForces(DOFtype='coupled', lines_only=True)``
+    (raft/raft.py:1326).  Per line: horizontal pull H toward the anchor,
+    vertical pull V downward, applied at the fairlead.
+    """
+    rf = fairlead_positions(sys, r6)
+    dxy = sys.r_anchor[:, :2] - rf[:, :2]
+    dist = jnp.sqrt(jnp.sum(dxy * dxy, axis=-1) + 1e-12)
+    u = dxy / dist[:, None]                        # unit vector toward anchor
+    st = line_states(sys, r6)
+    F3 = jnp.concatenate([st.H[:, None] * u, -st.V[:, None]], axis=-1)  # (nl,3)
+    # moments about the displaced platform reference point (PRP at r6[:3])
+    M3 = jnp.cross(rf - r6[:3], F3)
+    return jnp.concatenate([F3.sum(axis=0), M3.sum(axis=0)])
+
+
+def mooring_stiffness(sys: MooringSystem, r6: Array) -> Array:
+    """Linearized 6x6 mooring stiffness about r6: C = -d F_moor / d r6.
+
+    Equivalent of MoorPy ``getCoupledStiffness(lines_only=True)``
+    (raft/raft.py:1325,1354), computed exactly by forward-mode autodiff
+    through the catenary Newton solve.  The manual yaw-spring addition of the
+    reference (raft/raft.py:1359) is folded in here.
+    """
+    C = -jax.jacfwd(lambda x: mooring_force(sys, x))(r6)
+    return C.at[5, 5].add(sys.yaw_stiffness)
+
+
+def solve_equilibrium(
+    sys: MooringSystem,
+    F_const: Array,
+    C_body: Array,
+    r6_init: Array | None = None,
+    iters: int = 40,
+) -> tuple[Array, Array]:
+    """Mean-offset equilibrium of the moored platform.
+
+    Equivalent of MoorPy ``solveEquilibrium3(DOFtype='both')``
+    (raft/raft.py:1343): find r6 with
+    ``F_const - C_body r6 + F_moor(r6) = 0`` where ``F_const`` collects
+    weight + buoyancy + thrust (the reference's body.f6Ext) and ``C_body``
+    is the linearized hydrostatic + gravitational stiffness from statics.
+
+    Damped Newton with a fixed iteration count (shape-static, vmappable,
+    differentiable).  Returns (r6_eq, residual_norm).
+    """
+    if r6_init is None:
+        r6_init = jnp.zeros(6, dtype=sys.r_anchor.dtype)
+
+    def residual(r6):
+        return F_const - C_body @ r6 + mooring_force(sys, r6)
+
+    def body(r6, _):
+        r = residual(r6)
+        J = jax.jacfwd(residual)(r6)
+        dx = solve_re(J, -r)
+        # clamp translation steps to 10 m and rotation steps to 0.1 rad
+        cap = jnp.array([10.0, 10.0, 10.0, 0.1, 0.1, 0.1], dtype=r6.dtype)
+        dx = jnp.clip(dx, -cap, cap)
+        return r6 + dx, None
+
+    r6, _ = jax.lax.scan(body, r6_init, None, length=iters)
+    res = residual(r6)
+    return r6, jnp.sqrt(jnp.sum(res * res))

@@ -1,0 +1,9 @@
+"""Multi-device parallelism: design-batch sweeps over a TPU mesh."""
+from raft_tpu.parallel.sweep import (  # noqa: F401
+    forward_response,
+    grad_response_std,
+    make_mesh,
+    response_std,
+    scale_diameters,
+    sweep,
+)

@@ -1,0 +1,8 @@
+"""Frequency-domain solve engine: RAO fixed point + eigen analysis."""
+from raft_tpu.solve.dynamics import (  # noqa: F401
+    LinearCoeffs,
+    RAOResult,
+    impedance,
+    solve_dynamics,
+)
+from raft_tpu.solve.eigen import EigenResult, dominance_order, solve_eigen  # noqa: F401

@@ -132,8 +132,12 @@ def oracle(ms, wave, env, Xi=None):
         v_i = 0.25 * np.pi * ds[0] ** 2 * dls if circ else ds[0] * ds[1] * dls
         Amat = RHO * v_i * (nd["Ca_q"][n] * qq + nd["Ca_p1"][n] * p11 + nd["Ca_p2"][n] * p22)
         A += translate_mat(r, Amat)
+        # side axial term carries only the added-mass correction Ca_q: the
+        # axial FK force comes from the end/taper pressure terms (the
+        # reference's extra volume-form (1+Ca_q) double counts it, see
+        # DEVIATIONS.md)
         Imat = RHO * v_i * (
-            (1 + nd["Ca_q"][n]) * qq + (1 + nd["Ca_p1"][n]) * p11 + (1 + nd["Ca_p2"][n]) * p22
+            nd["Ca_q"][n] * qq + (1 + nd["Ca_p1"][n]) * p11 + (1 + nd["Ca_p2"][n]) * p22
         )
         for i in range(nw):
             F[i] += translate_force(r, Imat @ ud[:, i])
@@ -148,7 +152,7 @@ def oracle(ms, wave, env, Xi=None):
         A += translate_mat(r, RHO * v_e * nd["Ca_end"][n] * qq)
         Ie = RHO * v_e * (1 + nd["Ca_end"][n]) * qq
         for i in range(nw):
-            fe = Ie @ ud[:, i] + pd[i] * RHO * a_e * q
+            fe = Ie @ ud[:, i] + pd[i] * a_e * q    # pd is a true pressure (incl. rho)
             F[i] += translate_force(r, fe)
         # drag linearization
         if Xi_np is not None:

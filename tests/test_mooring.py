@@ -1,0 +1,168 @@
+"""Mooring tests.
+
+Oracles:
+  * independent numerical integration of the elastic catenary ODE in NumPy —
+    given the solved (H, V), integrating dx/ds, dz/ds over unstretched
+    arclength from anchor to fairlead must recover the imposed spans;
+  * taut-line limit: tension ~ EA * strain along the chord;
+  * the published OC3-Hywind mooring system: surge stiffness at zero offset
+    ~41.2 kN/m (Jonkman, NREL/TP-500-47535, Table 7-2 equivalent), symmetric
+    3-line geometry force balance;
+  * finite-difference check of the autodiff stiffness.
+"""
+import jax
+import jax.numpy as jnp
+import numpy as np
+import yaml
+
+from raft_tpu.mooring import (
+    LineProps,
+    mooring_force,
+    mooring_stiffness,
+    parse_mooring,
+    solve_catenary,
+    solve_equilibrium,
+)
+
+RHO, G = 1025.0, 9.81
+
+
+def integrate_catenary(H, V, L, w, EA, n=200_000):
+    """NumPy ODE oracle: spans from anchor to fairlead for given (H, V)."""
+    s = np.linspace(0.0, L, n)                 # unstretched arclength
+    Vv = V - w * (L - s)                       # vertical tension (suspended)
+    hanging = Vv > 0.0
+    Vv = np.maximum(Vv, 0.0)
+    T = np.sqrt(H * H + Vv * Vv)
+    dxds = np.where(hanging, (1.0 + T / EA) * H / T, 1.0 + H / EA)
+    dzds = np.where(hanging, (1.0 + T / EA) * Vv / T, 0.0)
+    return np.trapezoid(dxds, s), np.trapezoid(dzds, s)
+
+
+def check_roundtrip(xf, zf, L, w, EA, tol=1e-3):
+    p = LineProps(L=jnp.asarray(L), w=jnp.asarray(w), EA=jnp.asarray(EA))
+    st = solve_catenary(jnp.asarray(xf), jnp.asarray(zf), p)
+    assert float(st.residual) < 1e-6 * max(xf, zf)
+    x_ode, z_ode = integrate_catenary(float(st.H), float(st.V), L, w, EA)
+    np.testing.assert_allclose(x_ode, xf, rtol=tol)
+    np.testing.assert_allclose(z_ode, zf, rtol=tol)
+
+
+def test_catenary_slack_with_touchdown():
+    # OC3-like chain: large span, much of the line on the seabed
+    check_roundtrip(xf=848.67, zf=250.0, L=902.2, w=698.1, EA=384.243e6)
+
+
+def test_catenary_fully_suspended():
+    check_roundtrip(xf=650.0, zf=300.0, L=730.0, w=698.1, EA=384.243e6)
+
+
+def test_catenary_taut_limit():
+    L, w, EA = 400.0, 100.0, 1e9
+    xf, zf = 350.0, 220.0                      # chord 413.6 m > L: taut
+    p = LineProps(L=jnp.asarray(L), w=jnp.asarray(w), EA=jnp.asarray(EA))
+    st = solve_catenary(jnp.asarray(xf), jnp.asarray(zf), p)
+    chord = np.hypot(xf, zf)
+    T_est = EA * (chord - L) / L
+    assert abs(float(st.Tf) - T_est) / T_est < 0.1
+
+
+def test_catenary_batch_matches_scalar():
+    xs = jnp.array([848.67, 650.0, 700.0])
+    zs = jnp.array([250.0, 300.0, 280.0])
+    p = LineProps(
+        L=jnp.array([902.2, 730.0, 800.0]),
+        w=jnp.full(3, 698.1),
+        EA=jnp.full(3, 384.243e6),
+    )
+    st = solve_catenary(xs, zs, p)
+    for i in range(3):
+        pi = LineProps(L=p.L[i], w=p.w[i], EA=p.EA[i])
+        sti = solve_catenary(xs[i], zs[i], pi)
+        np.testing.assert_allclose(float(st.H[i]), float(sti.H), rtol=1e-8)
+
+
+# ------------------------------------------------------------- OC3 system
+
+
+def oc3_system():
+    with open("raft_tpu/designs/OC3spar.yaml") as f:
+        design = yaml.safe_load(f)
+    return parse_mooring(
+        design["mooring"],
+        yaw_stiffness=design["turbine"]["yaw_stiffness"],
+    )
+
+
+def test_oc3_zero_offset_balance():
+    sys = oc3_system()
+    F = mooring_force(sys, jnp.zeros(6))
+    # symmetric 3-line layout: horizontal forces and x/y moments cancel
+    assert abs(float(F[0])) < 1e3
+    assert abs(float(F[1])) < 1e3
+    # net vertical line pull is downward, order of the total wet line weight
+    assert float(F[2]) < 0
+    assert 0.3e6 < -float(F[2]) < 3e6
+
+
+def test_oc3_surge_stiffness_matches_published():
+    sys = oc3_system()
+    C = mooring_stiffness(sys, jnp.zeros(6))
+    # published OC3-Hywind effective surge stiffness ~41.2 kN/m about zero
+    assert 30e3 < float(C[0, 0]) < 55e3
+    # symmetry: surge and sway stiffness equal for the 120-degree layout
+    np.testing.assert_allclose(float(C[0, 0]), float(C[1, 1]), rtol=0.05)
+    # yaw spring folded in
+    C_no = mooring_stiffness(sys.replace(yaw_stiffness=0.0), jnp.zeros(6))
+    np.testing.assert_allclose(
+        float(C[5, 5] - C_no[5, 5]), 98340000.0, rtol=1e-6
+    )
+
+
+def test_stiffness_matches_finite_difference():
+    sys = oc3_system()
+    r6 = jnp.array([5.0, 1.0, -0.5, 0.01, 0.02, 0.005])
+    C = np.asarray(mooring_stiffness(sys.replace(yaw_stiffness=0.0), r6))
+    h = 1e-4
+    C_fd = np.zeros((6, 6))
+    for j in range(6):
+        e = np.zeros(6)
+        e[j] = h
+        Fp = np.asarray(mooring_force(sys, r6 + jnp.asarray(e)))
+        Fm = np.asarray(mooring_force(sys, r6 - jnp.asarray(e)))
+        C_fd[:, j] = -(Fp - Fm) / (2 * h)
+    np.testing.assert_allclose(C, C_fd, rtol=5e-3, atol=20.0)
+
+
+def test_equilibrium_under_thrust():
+    sys = oc3_system()
+    # body restoring: plausible OC3 hydrostatic + gravity stiffness
+    C_body = jnp.diag(jnp.array([0.0, 0.0, 3.3e5, 1.3e9, 1.3e9, 0.0]))
+    thrust = 800e3
+    F_const = jnp.array([thrust, 0.0, 0.0, 0.0, thrust * 90.0, 0.0])
+    # cancel the mean vertical line pull so heave stays near zero
+    F0 = mooring_force(sys, jnp.zeros(6))
+    F_const = F_const.at[2].add(-float(F0[2]))
+    r6, res = solve_equilibrium(sys, F_const, C_body)
+    # residual small relative to applied load
+    assert float(res) < 1e-3 * thrust
+    # surge offset tens of meters against ~41 kN/m net surge stiffness
+    assert 10.0 < float(r6[0]) < 40.0
+    assert abs(float(r6[1])) < 1.0
+
+
+def test_equilibrium_gradient_flows():
+    sys = oc3_system()
+    C_body = jnp.diag(jnp.array([0.0, 0.0, 3.3e5, 1.3e9, 1.3e9, 0.0]))
+
+    def surge_offset(thrust):
+        F_const = jnp.array([thrust, 0.0, 0.0, 0.0, thrust * 90.0, 0.0])
+        F0 = mooring_force(sys, jnp.zeros(6))
+        F_const = F_const.at[2].add(-F0[2])
+        r6, _ = solve_equilibrium(sys, F_const, C_body)
+        return r6[0]
+
+    g = jax.grad(surge_offset)(800e3)
+    h = 1e2
+    fd = (surge_offset(800e3 + h) - surge_offset(800e3 - h)) / (2 * h)
+    np.testing.assert_allclose(float(g), float(fd), rtol=1e-3)

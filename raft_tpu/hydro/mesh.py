@@ -1,0 +1,275 @@
+"""Axisymmetric panel mesher for potential-flow (BEM) members.
+
+Host-side preprocessing, the capability of the reference's ``member2pnl``
+(raft/member2pnl.py:8-509) re-designed around plain (n,4,3) numpy panel
+arrays instead of growing Python lists: build each ``potMod`` member's
+wetted surface as a revolved station profile (sides + end caps), transform
+by member pose, clip at the waterline, and emit HAMS ``.pnl`` / WAMIT
+``.gdf`` files or hand the panels straight to the native BEM solver.
+
+Panels are quads with vertices ordered so the normal points INTO the fluid
+(outward from the body); triangles are stored as degenerate quads (last
+vertex repeated), the convention both HAMS and WAMIT accept.
+"""
+from __future__ import annotations
+
+import numpy as np
+
+
+def _profile(stations: np.ndarray, radii: np.ndarray, dz_max: float):
+    """Refine a station profile so no axial span exceeds dz_max."""
+    zs, rs = [float(stations[0])], [float(radii[0])]
+    for i in range(1, len(stations)):
+        dz = stations[i] - stations[i - 1]
+        if dz <= 0:
+            # radius jump at equal station: keep both points (vertical flange)
+            zs.append(float(stations[i]))
+            rs.append(float(radii[i]))
+            continue
+        n = max(1, int(np.ceil(dz / dz_max)))
+        for j in range(1, n + 1):
+            f = j / n
+            zs.append(float(stations[i - 1] + f * dz))
+            rs.append(float(radii[i - 1] + f * (radii[i] - radii[i - 1])))
+    return np.array(zs), np.array(rs)
+
+
+def _cap_rings(r_outer: float, da_max: float):
+    """Radii for end-cap rings from r_outer down toward the axis."""
+    if r_outer <= 0:
+        return np.array([0.0])
+    n = max(1, int(np.ceil(r_outer / da_max)))
+    return np.linspace(r_outer, 0.0, n + 1)
+
+
+def mesh_member(
+    stations,
+    diameters,
+    rA,
+    rB,
+    dz_max: float = 3.0,
+    da_max: float = 2.0,
+    endA: bool = True,
+    endB: bool = True,
+) -> np.ndarray:
+    """Mesh one circular member: returns (np, 4, 3) panel vertex array.
+
+    ``stations`` are along-axis positions (member frame, 0 at end A),
+    ``diameters`` the matching outer diameters; ``rA``/``rB`` the global end
+    positions.  Sides are revolved quads; flat end caps are ring/triangle
+    fans (cf. the reference's radial end fill, raft/member2pnl.py:149-165).
+    """
+    stations = np.asarray(stations, dtype=float)
+    diameters = np.asarray(diameters, dtype=float)
+    rA = np.asarray(rA, dtype=float)
+    rB = np.asarray(rB, dtype=float)
+
+    zs, rs = _profile(stations, 0.5 * diameters, dz_max)
+    r_max = rs.max()
+    naz = max(8, int(np.ceil(2.0 * np.pi * r_max / da_max)))
+    th = np.linspace(0.0, 2.0 * np.pi, naz + 1)
+    cos, sin = np.cos(th), np.sin(th)
+
+    panels = []
+
+    def ring(r, z):
+        return np.stack([r * cos, r * sin, np.full(naz + 1, z)], axis=-1)  # (naz+1,3)
+
+    def band(ringA, ringB, flip=False):
+        """Quads between two rings; vertex order sets the normal."""
+        a0, a1 = ringA[:-1], ringA[1:]
+        b0, b1 = ringB[:-1], ringB[1:]
+        quad = np.stack([a0, a1, b1, b0], axis=1)          # (naz,4,3)
+        if flip:
+            quad = quad[:, ::-1, :]
+        panels.append(quad)
+
+    # sides: outward normal for increasing z profile (A low, B high in local
+    # frame; the pose rotation below handles the rest)
+    for i in range(len(zs) - 1):
+        if zs[i + 1] <= zs[i] and rs[i + 1] == rs[i]:
+            continue
+        rA_ring = ring(rs[i], zs[i])
+        rB_ring = ring(rs[i + 1], zs[i + 1])
+        band(rA_ring, rB_ring, flip=False)
+
+    # end caps: A faces -z (local), B faces +z
+    if endA and rs[0] > 0:
+        rr = _cap_rings(rs[0], da_max)
+        for i in range(len(rr) - 1):
+            band(ring(rr[i + 1], zs[0]), ring(rr[i], zs[0]), flip=False)
+    if endB and rs[-1] > 0:
+        rr = _cap_rings(rs[-1], da_max)
+        for i in range(len(rr) - 1):
+            band(ring(rr[i], zs[-1]), ring(rr[i + 1], zs[-1]), flip=False)
+
+    pans = np.concatenate(panels, axis=0)
+
+    # pose: local +z axis -> member axis q
+    axis = rB - rA
+    L = np.linalg.norm(axis)
+    q = axis / L
+    # scale local z from profile coordinate (already along-axis length)
+    z_hat = np.array([0.0, 0.0, 1.0])
+    v = np.cross(z_hat, q)
+    c = float(np.dot(z_hat, q))
+    if np.linalg.norm(v) < 1e-12:
+        R = np.eye(3) if c > 0 else np.diag([1.0, -1.0, -1.0])
+    else:
+        vx = np.array([[0, -v[2], v[1]], [v[2], 0, -v[0]], [-v[1], v[0], 0]])
+        R = np.eye(3) + vx + vx @ vx * ((1 - c) / (np.linalg.norm(v) ** 2))
+    pans = pans @ R.T + rA
+
+    return clip_waterline(pans)
+
+
+def clip_waterline(panels: np.ndarray, z_surface: float = 0.0) -> np.ndarray:
+    """Drop panels fully above the surface; clamp crossing vertices to z=0
+    (the reference's makePanel clip, raft/member2pnl.py:8-35).  Panels left
+    with zero area (all vertices clamped) are removed."""
+    z = panels[..., 2]
+    keep = (z < z_surface - 1e-9).any(axis=1)
+    pans = panels[keep].copy()
+    pans[..., 2] = np.minimum(pans[..., 2], z_surface)
+    area = panel_areas(pans)
+    return pans[area > 1e-10]
+
+
+def panel_centroids(panels: np.ndarray) -> np.ndarray:
+    return panels.mean(axis=1)
+
+
+def panel_normals_areas(panels: np.ndarray):
+    """Normals (unit) and areas of quad panels via the cross-diagonal rule."""
+    d1 = panels[:, 2] - panels[:, 0]
+    d2 = panels[:, 3] - panels[:, 1]
+    n = 0.5 * np.cross(d1, d2)
+    area = np.linalg.norm(n, axis=-1)
+    unit = n / np.where(area > 1e-12, area, 1.0)[:, None]
+    return unit, area
+
+
+def panel_areas(panels: np.ndarray) -> np.ndarray:
+    return panel_normals_areas(panels)[1]
+
+
+def mesh_volume(panels: np.ndarray) -> float:
+    """Enclosed volume by the divergence theorem, outward normals (the
+    z=0 waterplane lid contributes zero): V = sum(z * n_z * dA)."""
+    n, a = panel_normals_areas(panels)
+    zc = panel_centroids(panels)[:, 2]
+    return float((zc * n[:, 2] * a).sum())
+
+
+def mesh_design(design: dict, dz_max: float = 3.0, da_max: float = 2.0) -> np.ndarray:
+    """Mesh every ``potMod`` circular member of a design dict
+    (cf. FOWT.calcBEM, raft/raft.py:2016-2047).  Heading replication matches
+    the member builder."""
+    from raft_tpu.io.schema import get_from_dict
+
+    allp = []
+    for mi in design["platform"]["members"]:
+        if not mi.get("potMod", False):
+            continue
+        if str(mi["shape"])[0].lower() != "c":
+            continue                      # rect members stay on the Morison path
+        stations = np.asarray(mi["stations"], dtype=float)
+        stations = stations - stations[0]
+        d = np.asarray(mi["d"], dtype=float)
+        if d.ndim == 0:
+            d = np.full(len(stations), float(d))
+        headings = np.atleast_1d(get_from_dict(mi, "heading", shape=-1, default=0.0))
+        for h in headings:
+            rA = np.asarray(mi["rA"], dtype=float)
+            rB = np.asarray(mi["rB"], dtype=float)
+            if h != 0.0:
+                c, s = np.cos(np.deg2rad(h)), np.sin(np.deg2rad(h))
+                rot = np.array([[c, s, 0.0], [-s, c, 0.0], [0.0, 0.0, 1.0]])
+                rA, rB = rot @ rA, rot @ rB
+            allp.append(
+                mesh_member(stations, d, rA, rB, dz_max=dz_max, da_max=da_max)
+            )
+    if not allp:
+        return np.zeros((0, 4, 3))
+    return np.concatenate(allp, axis=0)
+
+
+# ------------------------------------------------------------- file output
+
+
+def write_pnl(path: str, panels: np.ndarray, x_sym: int = 0, y_sym: int = 0):
+    """HAMS hull-mesh file (cf. writeMesh, raft/member2pnl.py:279-305)."""
+    verts = panels.reshape(-1, 3)
+    # deduplicate vertices
+    uniq, inv = np.unique(np.round(verts, 6), axis=0, return_inverse=True)
+    conn = inv.reshape(-1, 4)
+    with open(path, "w") as f:
+        f.write("    --------------Hull Mesh File---------------\n\n")
+        f.write("    # Number of Panels, Nodes, X-Symmetry and Y-Symmetry\n")
+        f.write(f"    {len(conn):>8}    {len(uniq):>8}    {x_sym:>8}    {y_sym:>8}\n\n")
+        f.write("    # Start Definition of Node Coordinates     ! node_number   x   y   z\n")
+        for i, v in enumerate(uniq, 1):
+            f.write(f"    {i:<8}{v[0]:>14.6f}{v[1]:>18.6f}{v[2]:>18.6f}\n")
+        f.write("    # Start Definition of Node Relations   ! panel_number  number_of_vertices   Vertex1_ID   Vertex2_ID   Vertex3_ID   (Vertex4_ID)\n")
+        for i, c in enumerate(conn, 1):
+            ids = [int(x) + 1 for x in c]
+            # drop any duplicated consecutive vertex (axis fans degenerate on
+            # the first edge for cap A, the last for cap B)
+            uniq_ids = [v for j, v in enumerate(ids) if v != ids[j - 1]]
+            if len(uniq_ids) == 3:
+                f.write(
+                    f"    {i:<8}3    {uniq_ids[0]:>8}{uniq_ids[1]:>8}{uniq_ids[2]:>8}\n"
+                )
+            else:
+                f.write(f"    {i:<8}4    {ids[0]:>8}{ids[1]:>8}{ids[2]:>8}{ids[3]:>8}\n")
+        f.write("    --------------End Hull Mesh File---------------\n")
+
+
+def write_gdf(path: str, panels: np.ndarray, ulen: float = 1.0, g: float = 9.80665):
+    """WAMIT low-order .gdf file (cf. writeMeshToGDF, raft/member2pnl.py:496-509)."""
+    with open(path, "w") as f:
+        f.write("gdf mesh written by raft_tpu\n")
+        f.write(f"{ulen:>10.4f}{g:>10.5f}\n")
+        f.write("0  0\n")
+        f.write(f"{len(panels)}\n")
+        for p in panels:
+            for v in p:
+                f.write(f"{v[0]:>14.6f}{v[1]:>14.6f}{v[2]:>14.6f}\n")
+
+
+def read_pnl(path: str) -> np.ndarray:
+    """Read a HAMS .pnl mesh back into an (np,4,3) panel array."""
+    with open(path) as f:
+        lines = [ln.strip() for ln in f.readlines()]
+    counts = None
+    i = 0
+    for i, ln in enumerate(lines):
+        if ln.startswith("#") and "Number of Panels" in ln:
+            counts = [int(x) for x in lines[i + 1].split()]
+            break
+    if counts is None:
+        raise ValueError(f"{path}: no panel-count header found")
+    n_pan, n_node = counts[0], counts[1]
+    nodes = np.zeros((n_node, 3))
+    j = i + 2
+    seen = 0
+    while seen < n_node:
+        parts = lines[j].split()
+        j += 1
+        if len(parts) == 4 and not lines[j - 1].startswith("#"):
+            nodes[int(parts[0]) - 1] = [float(parts[1]), float(parts[2]), float(parts[3])]
+            seen += 1
+    panels = np.zeros((n_pan, 4, 3))
+    seen = 0
+    while seen < n_pan:
+        parts = lines[j].split()
+        j += 1
+        if not parts or lines[j - 1].startswith("#") or lines[j - 1].startswith("-"):
+            continue
+        nv = int(parts[1])
+        ids = [int(x) - 1 for x in parts[2 : 2 + nv]]
+        if nv == 3:
+            ids.append(ids[2])
+        panels[int(parts[0]) - 1] = nodes[ids]
+        seen += 1
+    return panels

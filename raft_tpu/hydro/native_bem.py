@@ -1,0 +1,132 @@
+"""ctypes wrapper for the native C++ BEM solver (raft_tpu/native/bem.cpp).
+
+The native solver is the framework's HAMS equivalent (the reference's only
+native component, hams/pyhams.py:361-373 + hams/bin/HAMS_x64.exe): given a
+hull panel mesh and a frequency grid it returns potential-flow added mass
+A(w), radiation damping B(w) and wave excitation X(w), which are staged to
+the JAX pipeline via ``Model(design, BEM=(A, B, F))``.
+
+The shared library is compiled on demand with g++ -O3 -fopenmp and cached
+next to the source; results are cached content-addressed (mesh + grid hash)
+under ``~/.cache/raft_tpu/bem`` — the formalization of the reference's
+compute-once/reuse WAMIT-file pattern (SURVEY.md §5 checkpoint/resume).
+"""
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+                    "native", "bem.cpp")
+_LIB_DIR = os.path.join(os.path.dirname(_SRC), "_build")
+_LIB = os.path.join(_LIB_DIR, "libraft_bem.so")
+
+_lib = None
+
+
+def _build_lib() -> str:
+    os.makedirs(_LIB_DIR, exist_ok=True)
+    src_mtime = os.path.getmtime(_SRC)
+    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
+        return _LIB
+    cmd = [
+        "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
+        _SRC, "-o", _LIB, "-lm",
+    ]
+    res = subprocess.run(cmd, capture_output=True, text=True)
+    if res.returncode != 0:
+        raise RuntimeError(f"BEM solver build failed:\n{res.stderr}")
+    return _LIB
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_build_lib())
+        lib.bem_solve_deep.restype = ctypes.c_int
+        lib.bem_solve_deep.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int,      # panels, np
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int,      # w, nw
+            ctypes.c_double, ctypes.c_double, ctypes.c_double,  # rho, g, beta
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int,
+        ]
+        lib.bem_wave_integral.restype = None
+        lib.bem_wave_integral.argtypes = [ctypes.c_double, ctypes.c_double,
+                                          ctypes.POINTER(ctypes.c_double),
+                                          ctypes.POINTER(ctypes.c_double)]
+        lib.bem_wave_integral_direct.restype = None
+        lib.bem_wave_integral_direct.argtypes = lib.bem_wave_integral.argtypes
+        _lib = lib
+    return _lib
+
+
+def wave_integral(X: float, Y: float, direct: bool = False):
+    """Probe the dimensionless PV wave integrals I0/I1 (unit tests)."""
+    lib = _load()
+    i0 = ctypes.c_double()
+    i1 = ctypes.c_double()
+    fn = lib.bem_wave_integral_direct if direct else lib.bem_wave_integral
+    fn(X, Y, ctypes.byref(i0), ctypes.byref(i1))
+    return i0.value, i1.value
+
+
+def solve_bem(
+    panels: np.ndarray,
+    w: np.ndarray,
+    rho: float = 1025.0,
+    g: float = 9.81,
+    beta: float = 0.0,
+    nthreads: int = 0,
+    cache: bool = True,
+):
+    """Run the native deep-water BEM solve.
+
+    panels: (np, 4, 3) hull mesh (outward normals); w: (nw,) rad/s.
+    Returns (A[6,6,nw], B[6,6,nw], F[6,nw] complex), reference-layout arrays
+    matching the WAMIT readers so either provider can feed the Model.
+    """
+    panels = np.ascontiguousarray(panels, dtype=np.float64)
+    w = np.ascontiguousarray(np.atleast_1d(w), dtype=np.float64)
+    n_p, n_w = len(panels), len(w)
+
+    key = None
+    if cache:
+        h = hashlib.sha256()
+        with open(_SRC, "rb") as f:
+            h.update(f.read())                # solver edits invalidate cache
+        h.update(panels.tobytes())
+        h.update(w.tobytes())
+        h.update(np.array([rho, g, beta]).tobytes())
+        key = os.path.join(
+            os.path.expanduser("~/.cache/raft_tpu/bem"), h.hexdigest()[:24] + ".npz"
+        )
+        if os.path.exists(key):
+            z = np.load(key)
+            return z["A"], z["B"], z["F"]
+
+    lib = _load()
+    A = np.zeros((n_w, 6, 6))
+    B = np.zeros((n_w, 6, 6))
+    Fre = np.zeros((n_w, 6))
+    Fim = np.zeros((n_w, 6))
+    dptr = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+    ret = lib.bem_solve_deep(
+        dptr(panels), n_p, dptr(w), n_w, rho, g, beta,
+        dptr(A), dptr(B), dptr(Fre), dptr(Fim), nthreads,
+    )
+    if ret != 0:
+        raise RuntimeError(f"bem_solve_deep failed with code {ret}")
+    A = A.transpose(1, 2, 0)
+    B = B.transpose(1, 2, 0)
+    F = (Fre + 1j * Fim).T
+
+    if cache and key is not None:
+        os.makedirs(os.path.dirname(key), exist_ok=True)
+        np.savez_compressed(key, A=A, B=B, F=F)
+    return A, B, F

@@ -1,0 +1,163 @@
+"""Mesher + BEM file-IO tests.
+
+Oracles: analytic cylinder volume/area for the mesher; synthetic round-trip
+golden files for the WAMIT parsers (written then re-read at 1e-12, the
+regression style of the reference's Capytaine test suite, SURVEY.md §4);
+out-of-range interpolation must raise (tests/test_capytaine_integration.py:31).
+"""
+import numpy as np
+import pytest
+
+from raft_tpu.hydro.bem_io import (
+    dimensionalize,
+    interp_to_grid,
+    load_wamit_coeffs,
+    read_wamit1,
+    read_wamit3,
+)
+from raft_tpu.hydro.mesh import (
+    clip_waterline,
+    mesh_design,
+    mesh_member,
+    mesh_volume,
+    panel_normals_areas,
+    read_pnl,
+    write_pnl,
+)
+from raft_tpu.model import load_design
+
+
+def test_cylinder_mesh_volume_and_normals():
+    p = mesh_member([0, 40], [10, 10], rA=[0, 0, -30], rB=[0, 0, 10], dz_max=2, da_max=1.0)
+    V = mesh_volume(p)
+    assert V == pytest.approx(np.pi / 4 * 100 * 30, rel=0.02)
+    n, a = panel_normals_areas(p)
+    assert a.sum() == pytest.approx(np.pi * 10 * 30 + np.pi / 4 * 100, rel=0.02)
+    # everything clipped at the waterline
+    assert p[..., 2].max() <= 1e-9
+
+
+def test_tapered_spar_mesh():
+    # OC3-like taper: d 9.4 below, 6.5 above
+    p = mesh_member(
+        [0, 108, 116, 130], [9.4, 9.4, 6.5, 6.5], rA=[0, 0, -120], rB=[0, 0, 10],
+        dz_max=3, da_max=2,
+    )
+    rA_, rB_ = 9.4 / 2, 6.5 / 2
+    V_expect = (
+        np.pi * rA_**2 * 108
+        + np.pi / 3 * 8 * (rA_**2 + rA_ * rB_ + rB_**2)   # conical frustum
+        + np.pi * rB_**2 * 4
+    )
+    # inscribed-polygon discretization at da_max=2 m underestimates ~2-3%
+    assert mesh_volume(p) == pytest.approx(V_expect, rel=0.04)
+
+
+def test_clip_drops_dry_panels():
+    p = mesh_member([0, 10], [5, 5], rA=[0, 0, 5], rB=[0, 0, 15])
+    assert len(clip_waterline(p)) == 0
+
+
+def test_mesh_design_oc3():
+    design = load_design("raft_tpu/designs/OC3spar.yaml")
+    p = mesh_design(design)
+    assert len(p) > 100
+    assert p[..., 2].max() <= 1e-9
+    assert mesh_volume(p) == pytest.approx(8029.0, rel=0.03)
+
+
+def test_pnl_round_trip(tmp_path):
+    p = mesh_member([0, 40], [10, 10], rA=[0, 0, -30], rB=[0, 0, 10], dz_max=4, da_max=2.5)
+    path = str(tmp_path / "HullMesh.pnl")
+    write_pnl(path, p)
+    q = read_pnl(path)
+    assert q.shape == p.shape
+    assert mesh_volume(q) == pytest.approx(mesh_volume(p), rel=1e-6)
+
+
+# ------------------------------------------------------------ WAMIT files
+
+
+def synth_wamit(tmp_path, nw=5):
+    rng = np.random.default_rng(3)
+    w = np.linspace(0.2, 1.0, nw)
+    A = rng.normal(size=(6, 6, nw))
+    B = rng.normal(size=(6, 6, nw))
+    Xre = rng.normal(size=(6, nw))
+    Xim = rng.normal(size=(6, nw))
+    p1 = tmp_path / "body.1"
+    with open(p1, "w") as f:
+        for iw in range(nw):
+            for i in range(6):
+                for j in range(6):
+                    f.write(f"{w[iw]:.6E} {i+1} {j+1} {A[i,j,iw]:.6E} {B[i,j,iw]:.6E}\n")
+    p3 = tmp_path / "body.3"
+    with open(p3, "w") as f:
+        for iw in range(nw):
+            for i in range(6):
+                mod = np.hypot(Xre[i, iw], Xim[i, iw])
+                ph = np.rad2deg(np.arctan2(Xim[i, iw], Xre[i, iw]))
+                f.write(
+                    f"{w[iw]:.6E} 0.000000E+00 {i+1} {mod:.6E} {ph:.6E} "
+                    f"{Xre[i,iw]:.6E} {Xim[i,iw]:.6E}\n"
+                )
+    return w, A, B, Xre, Xim, str(p1), str(p3)
+
+
+def test_wamit1_round_trip(tmp_path):
+    w, A, B, _, _, p1, _ = synth_wamit(tmp_path)
+    w_r, A_r, B_r = read_wamit1(p1)
+    np.testing.assert_allclose(w_r, w, rtol=1e-12)
+    np.testing.assert_allclose(A_r, A, rtol=1e-6)
+    np.testing.assert_allclose(B_r, B, rtol=1e-6)
+    assert A_r.shape == (6, 6, len(w))
+
+
+def test_wamit3_round_trip(tmp_path):
+    w, _, _, Xre, Xim, _, p3 = synth_wamit(tmp_path)
+    w_r, headings, mod, phase, re, im = read_wamit3(p3)
+    np.testing.assert_allclose(re, Xre, rtol=1e-6)
+    np.testing.assert_allclose(im, Xim, rtol=1e-6)
+    assert im.dtype == np.float64
+    assert len(headings) == 1
+
+
+def test_dimensionalize_scaling():
+    w = np.array([0.5, 1.0])
+    A_bar = np.ones((6, 6, 2))
+    B_bar = np.ones((6, 6, 2))
+    X = np.ones((6, 2))
+    A, B, F = dimensionalize(w, A_bar, B_bar, X, 0 * X, rho=1000.0, g=10.0)
+    assert A[0, 0, 0] == pytest.approx(1000.0)       # rho * A'
+    assert B[0, 0, 1] == pytest.approx(1000.0)       # rho * w * B'
+    assert B[0, 0, 0] == pytest.approx(500.0)
+    assert F[0, 0] == pytest.approx(10000.0)         # rho g X'
+    # ulen exponents: trans-trans ulen^3, cross ulen^4, rot-rot ulen^5,
+    # rotational excitation ulen^3
+    A2, _, F2 = dimensionalize(w, A_bar, B_bar, X, 0 * X, rho=1000.0, g=10.0, ulen=2.0)
+    assert A2[0, 0, 0] == pytest.approx(1000.0 * 8)
+    assert A2[0, 3, 0] == pytest.approx(1000.0 * 16)
+    assert A2[3, 3, 0] == pytest.approx(1000.0 * 32)
+    assert F2[3, 0] == pytest.approx(10000.0 * 8)
+
+
+def test_interp_out_of_range_raises():
+    w = np.linspace(0.2, 1.0, 5)
+    arr = np.ones((6, 5))
+    with pytest.raises(ValueError):
+        interp_to_grid(w, arr, np.linspace(0.1, 0.9, 4))
+    with pytest.raises(ValueError):
+        interp_to_grid(w, arr, np.linspace(0.3, 1.4, 4))
+    out = interp_to_grid(w, arr, np.linspace(0.3, 0.9, 4))
+    assert out.shape == (6, 4)
+
+
+def test_load_wamit_coeffs_end_to_end(tmp_path):
+    w, A, B, Xre, Xim, p1, p3 = synth_wamit(tmp_path)
+    grid = np.linspace(0.25, 0.95, 8)
+    A_d, B_d, F_d = load_wamit_coeffs(p1, p3, grid, rho=1025.0, g=9.81)
+    assert A_d.shape == (6, 6, 8)
+    assert F_d.dtype == complex
+    # spot value: A at grid point inside source range interpolates rho*A'
+    a_interp = np.interp(grid[0], w, A[0, 0])
+    np.testing.assert_allclose(A_d[0, 0, 0], 1025.0 * a_interp, rtol=1e-6)

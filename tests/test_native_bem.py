@@ -1,0 +1,102 @@
+"""Native C++ BEM solver tests.
+
+Oracles:
+  * exact single-layer identities on a deep sphere (added mass 0.5 rho V,
+    zero damping far from the free surface);
+  * mpmath evaluation of the dimensionless PV wave integral I0;
+  * the reference HAMS outputs for the 1008-panel cylinder
+    (raft/data/cylinder/Output/Wamit_format/Buoy.1/.3) on the identical
+    mesh — skipped if the reference tree is not mounted.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.hydro.native_bem import solve_bem, wave_integral
+
+REF = "/root/reference/raft/data/cylinder"
+
+
+def sphere_mesh(a=1.0, zc=-10.0, nth=20, naz=40):
+    th = np.linspace(0, np.pi, nth + 1)
+    pans = []
+    for i in range(nth):
+        for j in range(naz):
+            p0, p1 = th[i], th[i + 1]
+            a0, a1 = 2 * np.pi * j / naz, 2 * np.pi * (j + 1) / naz
+            pt = lambda pp, aa: [
+                a * np.sin(pp) * np.cos(aa),
+                a * np.sin(pp) * np.sin(aa),
+                zc + a * np.cos(pp),
+            ]
+            pans.append([pt(p0, a0), pt(p1, a0), pt(p1, a1), pt(p0, a1)])
+    return np.asarray(pans)
+
+
+def test_wave_integral_against_quadrature():
+    # table vs the independent pole-subtracted quadrature path
+    for X, Y in [(0.5, -0.5), (5.0, -0.1), (10.0, -2.0), (2.0, -20.0)]:
+        t0, t1 = wave_integral(X, Y)
+        d0, d1 = wave_integral(X, Y, direct=True)
+        assert t0 == pytest.approx(d0, rel=2e-3, abs=2e-4)
+        assert t1 == pytest.approx(d1, rel=2e-3, abs=2e-4)
+
+
+def test_deep_sphere_added_mass():
+    p = sphere_mesh()
+    A, B, F = solve_bem(p, np.array([1.0]), rho=1000.0, g=9.81, cache=False)
+    rhoV = 1000.0 * 4.0 / 3.0 * np.pi
+    for d in range(3):
+        assert A[d, d, 0] == pytest.approx(0.5 * rhoV, rel=0.05)
+    # far from the surface: no radiated waves, no excitation to speak of
+    assert abs(B[2, 2, 0]) < 0.01 * A[2, 2, 0]
+    # symmetry of the radiation matrix
+    assert A[0, 4, 0] == pytest.approx(A[4, 0, 0], abs=0.02 * rhoV)
+
+
+def test_model_with_native_bem_runs():
+    from raft_tpu.model import Model, load_design
+
+    m = Model(load_design("raft_tpu/designs/OC3spar.yaml"), BEM="native",
+              w=np.arange(0.1, 2.0, 0.1))
+    m.setEnv(Hs=8.0, Tp=12.0, V=10.0, Fthrust=800e3)
+    m.calcSystemProps()
+    assert m.bem is not None
+    A, B, F = m.bem
+    assert A.shape == (6, 6, 19)
+    # spar surge added mass from potential flow: order rho*V
+    assert 0.2e7 < A[0, 0, 0] < 2e7
+    # radiation damping nonnegative-ish diagonals at all freqs
+    assert (np.asarray(B[2, 2, :]) > -1e3).all()
+    m.solveEigen()
+    m.calcMooringAndOffsets()
+    m.solveDynamics()
+    resp = m.results["response"]
+    assert resp["converged"]
+    assert np.isfinite(resp["std dev"]).all()
+    # surge/pitch modes still in the published ballpark with BEM added mass
+    fns = m.results["eigen"]["frequencies"]
+    assert 0.004 < fns[0] < 0.015
+    assert 0.02 < fns[2] < 0.04
+
+
+@pytest.mark.skipif(not os.path.isdir(REF), reason="reference data not mounted")
+def test_cylinder_matches_hams():
+    from raft_tpu.hydro.bem_io import read_wamit1, read_wamit3
+    from raft_tpu.hydro.mesh import read_pnl
+
+    panels = read_pnl(os.path.join(REF, "Input", "HullMesh.pnl"))
+    w_h, A_h, B_h = read_wamit1(os.path.join(REF, "Output/Wamit_format/Buoy.1"))
+    _, _, mod, _, _, _ = read_wamit3(os.path.join(REF, "Output/Wamit_format/Buoy.3"))
+    rho, g = 1000.0, 9.80665
+    wsel = np.array([0.2, 2.0, 4.0])
+    A, B, F = solve_bem(panels, wsel, rho=rho, g=g, cache=False)
+    for i, wv in enumerate(wsel):
+        ih = int(np.argmin(np.abs(w_h - wv)))
+        assert A[0, 0, i] == pytest.approx(rho * A_h[0, 0, ih], rel=0.04)
+        assert A[2, 2, i] == pytest.approx(rho * A_h[2, 2, ih], rel=0.04)
+        assert A[4, 4, i] == pytest.approx(rho * A_h[4, 4, ih], rel=0.04)
+        assert B[2, 2, i] == pytest.approx(rho * wv * B_h[2, 2, ih], rel=0.05, abs=0.02)
+        assert abs(F[0, i]) == pytest.approx(rho * g * mod[0, ih], rel=0.04)
+        assert abs(F[2, i]) == pytest.approx(rho * g * mod[2, ih], rel=0.04)

@@ -30,6 +30,7 @@ from raft_tpu.mooring import (
 )
 from raft_tpu.solve import LinearCoeffs, solve_dynamics, solve_eigen
 from raft_tpu.statics import assemble_statics
+from raft_tpu.utils.profiling import phase
 
 Array = jnp.ndarray
 
@@ -115,20 +116,21 @@ class Model:
         from raft_tpu.hydro.mesh import mesh_design, write_gdf, write_pnl
         from raft_tpu.hydro.native_bem import solve_bem
 
-        panels = mesh_design(self.design, dz_max=dz_max, da_max=da_max)
-        if len(panels) == 0:
-            return None
-        if out_dir is not None:
-            import os
+        with phase("calcBEM"):
+            panels = mesh_design(self.design, dz_max=dz_max, da_max=da_max)
+            if len(panels) == 0:
+                return None
+            if out_dir is not None:
+                import os
 
-            os.makedirs(out_dir, exist_ok=True)
-            write_pnl(os.path.join(out_dir, "HullMesh.pnl"), panels)
-            write_gdf(os.path.join(out_dir, "platform.gdf"), panels)
-        self.bem = solve_bem(
-            panels, np.asarray(self.w),
-            rho=float(self.env.rho), g=float(self.env.g),
-            beta=float(self.env.beta),
-        )
+                os.makedirs(out_dir, exist_ok=True)
+                write_pnl(os.path.join(out_dir, "HullMesh.pnl"), panels)
+                write_gdf(os.path.join(out_dir, "platform.gdf"), panels)
+            self.bem = solve_bem(
+                panels, np.asarray(self.w),
+                rho=float(self.env.rho), g=float(self.env.g),
+                beta=float(self.env.beta),
+            )
         return self.bem
 
     def calcSystemProps(self):
@@ -139,19 +141,24 @@ class Model:
         if self.bem_mode == "native" and self.bem is None:
             self.calcBEM()
         exclude = self.bem is not None
-        self.statics = assemble_statics(self.members, self.rna, self.env)
-        self.kin = node_kinematics(self.members, self.wave, self.env)
-        self.A_morison = strip_added_mass(self.members, self.env, exclude_potmod=exclude)
-        self.F_morison = strip_excitation(
-            self.members, self.kin, self.env, exclude_potmod=exclude
-        )
-        if self.moor is not None:
-            z6 = jnp.zeros(6)
-            self.C_moor0 = mooring_stiffness(self.moor, z6)
-            self.F_moor0 = mooring_force(self.moor, z6)
-        else:
-            self.C_moor0 = jnp.zeros((6, 6))
-            self.F_moor0 = jnp.zeros(6)
+        with phase("statics"):
+            self.statics = assemble_statics(self.members, self.rna, self.env)
+        with phase("hydro-strip"):
+            self.kin = node_kinematics(self.members, self.wave, self.env)
+            self.A_morison = strip_added_mass(
+                self.members, self.env, exclude_potmod=exclude
+            )
+            self.F_morison = strip_excitation(
+                self.members, self.kin, self.env, exclude_potmod=exclude
+            )
+        with phase("mooring-stiffness"):
+            if self.moor is not None:
+                z6 = jnp.zeros(6)
+                self.C_moor0 = mooring_stiffness(self.moor, z6)
+                self.F_moor0 = mooring_force(self.moor, z6)
+            else:
+                self.C_moor0 = jnp.zeros((6, 6))
+                self.F_moor0 = jnp.zeros(6)
         self.C_moor = self.C_moor0
         self.F_moor = self.F_moor0
         self.results["properties"] = self._properties()
@@ -194,9 +201,10 @@ class Model:
         s = self.statics
         F_const = s.W_struc + s.W_hydro + self.f6Ext
         C_body = s.C_struc + s.C_hydro
-        self.r6_eq, res = solve_equilibrium(self.moor, F_const, C_body)
-        self.C_moor = mooring_stiffness(self.moor, self.r6_eq)
-        self.F_moor = mooring_force(self.moor, self.r6_eq)
+        with phase("mooring-equilibrium"):
+            self.r6_eq, res = solve_equilibrium(self.moor, F_const, C_body)
+            self.C_moor = mooring_stiffness(self.moor, self.r6_eq)
+            self.F_moor = mooring_force(self.moor, self.r6_eq)
         fair = {}
         self.results["means"] = {
             "platform offset": np.asarray(self.r6_eq),
@@ -220,7 +228,8 @@ class Model:
             # (raft/raft.py:1380) because its BEM arrays are always zero.
             M_tot = M_tot + jnp.asarray(np.asarray(self.bem[0])[:, :, 0])
         C_tot = self.statics.C_struc + self.statics.C_hydro + self.C_moor0
-        self.eigen = solve_eigen(M_tot, C_tot)
+        with phase("eigen"):
+            self.eigen = solve_eigen(M_tot, C_tot)
         self.results["eigen"] = {
             "frequencies": np.asarray(self.eigen.fns),
             "periods": np.asarray(1.0 / np.maximum(self.eigen.fns, 1e-12)),
@@ -255,10 +264,11 @@ class Model:
         if self.statics is None:
             self.calcSystemProps()
         lin = self._linear_coeffs()
-        self.rao = solve_dynamics(
-            self.members, self.kin, self.wave, self.env, lin,
-            n_iter=nIter, tol=tol, method=method,
-        )
+        with phase("rao-solve"):
+            self.rao = solve_dynamics(
+                self.members, self.kin, self.wave, self.env, lin,
+                n_iter=nIter, tol=tol, method=method,
+            )
         Xi = self.rao.Xi
         zeta = np.maximum(np.asarray(self.wave.zeta), 1e-12)
         dw = float(self.w[1] - self.w[0]) if len(self.w) > 1 else 1.0
@@ -295,6 +305,41 @@ class Model:
             np.sqrt((np.abs(a_nac) ** 2).sum() * dw)
         )
         return self.results
+
+    def print_report(self):
+        """Human-readable property/results report (the reference prints this
+        from calcOutputs, raft/raft.py:1606-1627)."""
+        p = self.results.get("properties", {})
+        print("=== raft_tpu analysis report ===")
+        for key in (
+            "total mass", "substructure mass", "shell mass", "ballast mass",
+            "tower mass", "displacement", "buoyancy (pgV)", "waterplane area",
+            "metacentric height",
+        ):
+            if key in p:
+                print(f"  {key:<22} {p[key]:14.4g}")
+        for key in ("total CG", "substructure CG", "center of buoyancy"):
+            if key in p:
+                v = p[key]
+                print(f"  {key:<22} [{v[0]:9.3f} {v[1]:9.3f} {v[2]:9.3f}]")
+        if "eigen" in self.results:
+            fns = self.results["eigen"]["frequencies"]
+            print("  natural frequencies [Hz] (surge..yaw):")
+            print("   ", " ".join(f"{f:8.5f}" for f in fns))
+            print("  natural periods [s]:")
+            print("   ", " ".join(f"{t:8.2f}" for t in self.results["eigen"]["periods"]))
+        if "means" in self.results:
+            r6 = self.results["means"]["platform offset"]
+            print(f"  mean offsets: surge {r6[0]:.2f} m, sway {r6[1]:.2f} m, "
+                  f"heave {r6[2]:.2f} m, pitch {np.rad2deg(r6[4]):.2f} deg")
+        if "response" in self.results:
+            s = self.results["response"]["std dev"]
+            print("  response std dev (surge..yaw):")
+            print("   ", " ".join(f"{x:9.4g}" for x in s))
+            if "nacelle acceleration std dev" in self.results["response"]:
+                print(f"  nacelle accel std dev: "
+                      f"{self.results['response']['nacelle acceleration std dev']:.3f} m/s^2")
+        print("================================")
 
     # ---------------------------------------------------------------- plot
 

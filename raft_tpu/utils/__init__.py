@@ -1,0 +1,2 @@
+"""Utilities: profiling/tracing hooks and shared helpers."""
+from raft_tpu.utils.profiling import phase, reset, summary, xla_trace  # noqa: F401

@@ -1,0 +1,84 @@
+"""Tracing / profiling hooks.
+
+The reference has no instrumentation at all (SURVEY.md §5); this module is
+the greenfield equivalent: lightweight wall-clock phase timers that nest,
+a summary table, and an optional bridge into ``jax.profiler`` traces for
+XLA-level timelines viewable in TensorBoard/Perfetto.
+"""
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+_totals: dict = defaultdict(float)
+_counts: dict = defaultdict(int)
+_stack: list = []
+
+
+@contextlib.contextmanager
+def phase(name: str, jax_trace: bool = False, sync: bool = True):
+    """Time a named phase (nested names join with '/').
+
+    JAX dispatch is asynchronous: without a device sync, a block would be
+    charged only its trace/dispatch time and the compute would bleed into a
+    later phase.  ``sync=True`` (default) blocks on all live device arrays
+    at phase exit so wall-clock numbers are honest; pass ``sync=False``
+    inside hot loops where the barrier would serialize useful overlap.
+
+    With ``jax_trace=True`` the block is also annotated in the JAX profiler
+    timeline (requires an active ``start_trace``)."""
+    full = "/".join([*_stack, name])
+    _stack.append(name)
+    ctx = contextlib.nullcontext()
+    if jax_trace:
+        import jax.profiler
+
+        ctx = jax.profiler.TraceAnnotation(full)
+    t0 = time.perf_counter()
+    try:
+        with ctx:
+            yield
+            if sync:
+                import jax
+
+                (jax.effects_barrier if hasattr(jax, "effects_barrier") else _noop)()
+                for d in jax.live_arrays():
+                    d.block_until_ready()
+    finally:
+        dt = time.perf_counter() - t0
+        _stack.pop()
+        _totals[full] += dt
+        _counts[full] += 1
+
+
+def _noop():
+    pass
+
+
+@contextlib.contextmanager
+def xla_trace(log_dir: str):
+    """Capture a JAX/XLA profiler trace for the enclosed block
+    (open with TensorBoard or Perfetto)."""
+    import jax.profiler
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+def summary() -> str:
+    """Formatted table of accumulated phase timings."""
+    lines = ["phase                                    calls   total [s]   mean [ms]"]
+    for name in sorted(_totals):
+        n = _counts[name]
+        tot = _totals[name]
+        lines.append(f"{name:<40} {n:>5} {tot:>11.3f} {tot / n * 1e3:>11.2f}")
+    return "\n".join(lines)
+
+
+def reset():
+    _totals.clear()
+    _counts.clear()

@@ -1,0 +1,99 @@
+"""CLI, WEIS adapter, profiling, and design-variant regression tests."""
+import numpy as np
+import pytest
+
+from raft_tpu.model import Model, load_design
+
+
+def test_oc4_split_variant_matches_single_member():
+    """OC4semi_2 (split-column decomposition) must reproduce OC4semi statics
+    to machine precision — same platform, different member decomposition."""
+    a = Model(load_design("raft_tpu/designs/OC4semi.yaml"))
+    b = Model(load_design("raft_tpu/designs/OC4semi_2.yaml"))
+    a.setEnv()
+    b.setEnv()
+    a.calcSystemProps()
+    b.calcSystemProps()
+    pa, pb = a.results["properties"], b.results["properties"]
+    for key in ("substructure mass", "displacement", "ballast mass", "total mass"):
+        assert pa[key] == pytest.approx(pb[key], rel=1e-9)
+    np.testing.assert_allclose(pa["substructure CG"], pb["substructure CG"], atol=1e-6)
+    np.testing.assert_allclose(pa["C_stiffness"], pb["C_stiffness"], rtol=1e-9, atol=1e-3)
+
+
+def test_cli_json(capsys):
+    import json
+
+    from raft_tpu.cli import main
+
+    main(["oc3", "--wmin", "0.2", "--wmax", "1.2", "--dw", "0.2", "--json"])
+    out = capsys.readouterr().out
+    data = json.loads(out.strip().splitlines()[-1])
+    assert "eigen" in data and "response" in data
+
+
+def test_print_report(capsys):
+    m = Model(load_design("raft_tpu/designs/OC3spar.yaml"),
+              w=np.arange(0.2, 1.2, 0.2))
+    m.setEnv(Fthrust=800e3)
+    m.calcSystemProps()
+    m.solveEigen()
+    m.print_report()
+    out = capsys.readouterr().out
+    assert "natural frequencies" in out
+    assert "total mass" in out
+
+
+def test_profiling_phases():
+    from raft_tpu.utils import profiling
+
+    profiling.reset()
+    m = Model(load_design("raft_tpu/designs/OC3spar.yaml"),
+              w=np.arange(0.2, 1.2, 0.2))
+    m.setEnv()
+    m.calcSystemProps()
+    s = profiling.summary()
+    assert "statics" in s
+    assert "hydro-strip" in s
+
+
+def test_weis_adapter_end_to_end():
+    from raft_tpu.io.weis import design_from_weis, member_from_arrays, mooring_from_arrays
+
+    spar = member_from_arrays(
+        "spar", [0, 0, -120], [0, 0, 10], [9.4, 9.4, 6.5, 6.5], [0.027],
+        stations=[-120, -12, -4, 10], potMod=False, Cd=0.8, Ca=1.0,
+        rho_shell=8500, l_fill=[52.0, 0, 0], rho_fill=[1860.0, 0, 0],
+    )
+    tower = member_from_arrays(
+        "tower", [0, 0, 10], [0, 0, 87.6], [6.5, 3.87], [0.027, 0.019],
+        mtype=1, Cd=0.0, Ca=0.0,
+    )
+    ang = np.deg2rad([0, 120, 240])
+    moor = mooring_from_arrays(
+        320.0,
+        np.stack([853.87 * np.cos(ang), 853.87 * np.sin(ang), np.full(3, -320.0)], -1),
+        np.stack([5.2 * np.cos(ang), 5.2 * np.sin(ang), np.full(3, -70.0)], -1),
+        [902.2] * 3,
+        diameter=0.09, mass_density=77.7066, stiffness=384.243e6,
+    )
+    design = design_from_weis(
+        [spar], tower,
+        {"mRNA": 350000, "IxRNA": 3.5e7, "IrRNA": 2.6e7, "xCG_RNA": 0,
+         "hHub": 90.0, "Fthrust": 800e3, "yaw_stiffness": 9.834e7},
+        moor,
+    )
+    m = Model(design, w=np.arange(0.2, 1.4, 0.2))
+    m.setEnv(Fthrust=800e3)
+    m.calcSystemProps()
+    m.solveEigen()
+    m.calcMooringAndOffsets()
+    m.solveDynamics()
+    assert m.results["response"]["converged"]
+    # same spar as the bundled OC3 design: displacement should agree ~2%
+    oc3 = Model(load_design("raft_tpu/designs/OC3spar.yaml"))
+    oc3.setEnv()
+    oc3.calcSystemProps()
+    assert m.results["properties"]["displacement"] == pytest.approx(
+        oc3.results["properties"]["displacement"], rel=0.02
+    )

@@ -124,6 +124,8 @@ def test_oc4_natural_frequencies(oc4):
     heave ~0.0576 Hz, pitch ~0.0388 Hz, yaw ~0.0125 Hz."""
     oc4.solveEigen()
     fns = oc4.results["eigen"]["frequencies"]
+    # 120-degree symmetric mooring: surge and sway must be degenerate
+    assert fns[0] == pytest.approx(fns[1], rel=1e-3)
     assert 0.007 < fns[0] < 0.012      # surge
     assert 0.048 < fns[2] < 0.068      # heave
     assert 0.030 < fns[3] < 0.048      # roll

@@ -1,0 +1,12 @@
+"""Sphinx configuration for raft_tpu."""
+project = "raft_tpu"
+author = "raft_tpu developers"
+release = "0.1.0"
+
+extensions = [
+    "sphinx.ext.autodoc",
+    "sphinx.ext.napoleon",
+    "sphinx.ext.viewcode",
+]
+html_theme = "alabaster"
+exclude_patterns = ["_build"]

@@ -81,5 +81,12 @@ def main(argv=None):
     return results
 
 
-if __name__ == "__main__":
+def entry_point():
+    """Console-script wrapper: setuptools calls sys.exit(return value), so
+    swallow main()'s results dict and return a clean 0."""
     main()
+    return 0
+
+
+if __name__ == "__main__":
+    entry_point()

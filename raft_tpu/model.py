@@ -116,6 +116,17 @@ class Model:
         from raft_tpu.hydro.mesh import mesh_design, write_gdf, write_pnl
         from raft_tpu.hydro.native_bem import solve_bem
 
+        k_min = float(np.asarray(self.w)[0]) ** 2 / float(self.env.g)
+        if k_min * self.depth < np.pi:
+            import warnings
+
+            warnings.warn(
+                f"native BEM uses the deep-water Green function, but "
+                f"k*depth = {k_min * self.depth:.2f} < pi at the lowest "
+                f"frequency — low-frequency BEM coefficients are approximate "
+                f"at {self.depth:.0f} m depth",
+                stacklevel=2,
+            )
         with phase("calcBEM"):
             panels = mesh_design(self.design, dz_max=dz_max, da_max=da_max)
             if len(panels) == 0:
@@ -343,21 +354,44 @@ class Model:
 
     # ---------------------------------------------------------------- plot
 
-    def plot(self, ax=None, hideGrid: bool = False):
-        """3D wireframe of members + mooring lines (cf. raft/raft.py:1715-1738)."""
+    def plot(self, ax=None, hideGrid: bool = False, n_ring: int = 24):
+        """3D wireframe of members + mooring lines: end rings and
+        longitudinal edges per segment (cf. Member.plot raft/raft.py:799-856
+        and Model.plot :1715-1738)."""
         import matplotlib.pyplot as plt
 
         if ax is None:
             fig = plt.figure(figsize=(8, 8))
             ax = fig.add_subplot(projection="3d")
         m = self.members
-        seg_mask = np.asarray(m.seg_mask)
-        rA = np.asarray(m.seg_rA)[seg_mask]
-        q = np.asarray(m.seg_q)[seg_mask]
-        L = np.asarray(m.seg_l)[seg_mask]
-        rB = rA + q * L[:, None]
-        for a, b in zip(rA, rB):
-            ax.plot(*np.stack([a, b]).T, "k-", lw=1)
+        keep = np.asarray(m.seg_mask & ~m.seg_is_cap)
+        rA = np.asarray(m.seg_rA)[keep]
+        q = np.asarray(m.seg_q)[keep]
+        R = np.asarray(m.seg_R)[keep]
+        L = np.asarray(m.seg_l)[keep]
+        dA = np.asarray(m.seg_dA)[keep]
+        dB = np.asarray(m.seg_dB)[keep]
+        circ = np.asarray(m.seg_circ)[keep]
+        th = np.linspace(0, 2 * np.pi, n_ring + 1)
+        for i in range(len(rA)):
+            rB_i = rA[i] + q[i] * L[i]
+            p1, p2 = R[i][:, 0], R[i][:, 1]
+            if circ[i]:
+                ringA = rA[i] + 0.5 * dA[i, 0] * (
+                    np.outer(np.cos(th), p1) + np.outer(np.sin(th), p2)
+                )
+                ringB = rB_i + 0.5 * dB[i, 0] * (
+                    np.outer(np.cos(th), p1) + np.outer(np.sin(th), p2)
+                )
+            else:
+                sq = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1], [1, 1]]) * 0.5
+                ringA = rA[i] + sq[:, :1] * dA[i, 0] * p1 + sq[:, 1:] * dA[i, 1] * p2
+                ringB = rB_i + sq[:, :1] * dB[i, 0] * p1 + sq[:, 1:] * dB[i, 1] * p2
+            ax.plot(*ringA.T, "k-", lw=0.6)
+            ax.plot(*ringB.T, "k-", lw=0.6)
+            step = max(1, len(ringA) // 8)
+            for j in range(0, len(ringA), step):
+                ax.plot(*np.stack([ringA[j], ringB[j]]).T, "k-", lw=0.4)
         if self.moor is not None:
             from raft_tpu.mooring import fairlead_positions, line_states
 

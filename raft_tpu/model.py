@@ -23,10 +23,12 @@ from raft_tpu.hydro import (
     strip_excitation,
 )
 from raft_tpu.mooring import (
+    fairlead_tensions,
     mooring_force,
     mooring_stiffness,
     parse_mooring,
     solve_equilibrium,
+    tension_jacobian,
 )
 from raft_tpu.solve import LinearCoeffs, solve_dynamics, solve_eigen
 from raft_tpu.statics import assemble_statics
@@ -216,12 +218,12 @@ class Model:
             self.r6_eq, res = solve_equilibrium(self.moor, F_const, C_body)
             self.C_moor = mooring_stiffness(self.moor, self.r6_eq)
             self.F_moor = mooring_force(self.moor, self.r6_eq)
-        fair = {}
+            T_mean = fairlead_tensions(self.moor, self.r6_eq)
         self.results["means"] = {
             "platform offset": np.asarray(self.r6_eq),
             "equilibrium residual": float(res),
             "mooring force": np.asarray(self.F_moor),
-            **fair,
+            "fairlead tensions": np.asarray(T_mean),
         }
         return self
 
@@ -315,6 +317,18 @@ class Model:
         self.results["response"]["nacelle acceleration std dev"] = float(
             np.sqrt((np.abs(a_nac) ** 2).sum() * dw)
         )
+        # fairlead tension RAOs: linearized line tension about the mean
+        # offset (the reference's intended output, raft/raft.py:1655-1708)
+        if self.moor is not None and self.r6_eq is not None:
+            J = np.asarray(tension_jacobian(self.moor, self.r6_eq))  # (nl,6)
+            T_amp = Xi @ J.T                                         # (nw,nl)
+            self.results["response"]["fairlead tension amplitude"] = np.abs(T_amp)
+            self.results["response"]["fairlead tension RAO"] = (
+                np.abs(T_amp) / zeta[:, None]
+            )
+            self.results["response"]["fairlead tension std dev"] = np.sqrt(
+                (np.abs(T_amp) ** 2).sum(axis=0) * dw
+            )
         return self.results
 
     def print_report(self):

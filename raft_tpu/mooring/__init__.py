@@ -7,9 +7,11 @@ from raft_tpu.mooring.catenary import (  # noqa: F401
 from raft_tpu.mooring.system import (  # noqa: F401
     MooringSystem,
     fairlead_positions,
+    fairlead_tensions,
     line_states,
     mooring_force,
     mooring_stiffness,
     parse_mooring,
     solve_equilibrium,
+    tension_jacobian,
 )

@@ -140,6 +140,22 @@ def mooring_stiffness(sys: MooringSystem, r6: Array) -> Array:
     return C.at[5, 5].add(sys.yaw_stiffness)
 
 
+def fairlead_tensions(sys: MooringSystem, r6: Array) -> Array:
+    """Fairlead tension magnitude per line at platform displacement r6 (nl,)."""
+    return line_states(sys, r6).Tf
+
+
+def tension_jacobian(sys: MooringSystem, r6: Array) -> Array:
+    """d T_fairlead / d r6 — (nl, 6), exact via forward-mode autodiff.
+
+    The reference documents fairlead-tension RAOs as an intended output in
+    a commented MATLAB-heritage block (raft/raft.py:1655-1708); combined
+    with the platform response this linearization delivers them:
+    ``T_RAO(w) = J @ Xi(w)``.
+    """
+    return jax.jacfwd(lambda x: fairlead_tensions(sys, x))(r6)
+
+
 def solve_equilibrium(
     sys: MooringSystem,
     F_const: Array,

@@ -81,6 +81,22 @@ def test_oc3_rao_solve(model):
     assert sigma[2] < 1.0
 
 
+def test_fairlead_tension_outputs(model):
+    model.calcMooringAndOffsets()
+    model.solveDynamics()
+    model.calcOutputs()
+    T = model.results["means"]["fairlead tensions"]
+    assert T.shape == (3,)
+    # OC3 pretension ~900 kN at zero offset; at the thrust offset the
+    # downwind line relaxes and the upwind pair loads up
+    assert 0.2e6 < T.min() < 1.0e6 < T.max() < 2.5e6
+    sd = model.results["response"]["fairlead tension std dev"]
+    assert sd.shape == (3,)
+    assert (sd > 100.0).all() and (sd < 0.3e6).all()
+    rao = model.results["response"]["fairlead tension RAO"]
+    assert np.isfinite(rao).all()
+
+
 def test_outputs_nacelle_accel(model):
     model.calcMooringAndOffsets()
     model.solveDynamics()

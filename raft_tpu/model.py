@@ -265,8 +265,13 @@ class Model:
             B = B + jnp.asarray(np.moveaxis(np.asarray(B_bem), -1, 0))
             from raft_tpu.core.cplx import Cx
 
+            # BEM excitation is per unit wave amplitude; the Morison
+            # excitation is on the spectral-amplitude basis (wave kinematics
+            # scale with zeta = sqrt(S), core/waves.py).  Scale by zeta per
+            # frequency so the bases match before summing.
             Fb = np.moveaxis(np.asarray(F_bem), -1, 0)   # complex on host only
-            F = F + Cx(jnp.asarray(Fb.real), jnp.asarray(Fb.imag))
+            zeta = np.asarray(self.wave.zeta)[:, None]
+            F = F + Cx(jnp.asarray(zeta * Fb.real), jnp.asarray(zeta * Fb.imag))
         return LinearCoeffs(M=M, B=B, C=C, F=F)
 
     def solveDynamics(self, nIter: int = 40, tol: float = 0.01, method="while"):

@@ -121,6 +121,50 @@ def load_wamit_coeffs(path1: str, path3: str, w_grid, rho=1025.0, g=9.81):
     )
 
 
+def nondimensionalize(w, A, B, F, rho=1025.0, g=9.81, ulen=1.0):
+    """SI -> WAMIT nondimensional (inverse of :func:`dimensionalize`)."""
+    k = np.zeros((6, 6))
+    for i in range(6):
+        for j in range(6):
+            k[i, j] = 3 + (i >= 3) + (j >= 3)
+    m = np.where(np.arange(6) < 3, 2.0, 3.0)
+    A_bar = np.asarray(A) / (rho * (ulen ** k)[:, :, None])
+    B_bar = np.asarray(B) / (rho * (ulen ** k)[:, :, None] * np.asarray(w)[None, None, :])
+    X_bar = np.asarray(F) / (rho * g * (ulen ** m)[:, None])
+    return A_bar, B_bar, X_bar
+
+
+def write_wamit1(path: str, w, A, B, rho=1025.0, g=9.81, ulen=1.0):
+    """Write a WAMIT .1 added-mass/damping file from SI arrays
+    (A[6,6,nw], B[6,6,nw]) — the format HAMS emits to
+    Output/Wamit_format (cf. read_wamit1)."""
+    A_bar, B_bar, _ = nondimensionalize(w, A, B, np.zeros((6, len(w))),
+                                        rho=rho, g=g, ulen=ulen)
+    with open(path, "w") as f:
+        for iw, wv in enumerate(np.asarray(w)):
+            for i in range(6):
+                for j in range(6):
+                    f.write(f" {wv:13.6E} {i+1:5d} {j+1:5d} "
+                            f"{A_bar[i, j, iw]:13.6E} {B_bar[i, j, iw]:13.6E}\n")
+    return path
+
+
+def write_wamit3(path: str, w, F, rho=1025.0, g=9.81, ulen=1.0, heading=0.0):
+    """Write a WAMIT .3 excitation file from SI F[6,nw] (complex, per unit
+    wave amplitude)."""
+    _, _, X_bar = nondimensionalize(w, np.zeros((6, 6, len(w))),
+                                    np.ones((6, 6, len(w))), F,
+                                    rho=rho, g=g, ulen=ulen)
+    with open(path, "w") as f:
+        for iw, wv in enumerate(np.asarray(w)):
+            for i in range(6):
+                x = X_bar[i, iw]
+                f.write(f" {wv:13.6E} {heading:10.3f} {i+1:5d} "
+                        f"{abs(x):13.6E} {np.degrees(np.angle(x)):13.6E} "
+                        f"{x.real:13.6E} {x.imag:13.6E}\n")
+    return path
+
+
 # ------------------------------------------------------ HAMS project files
 
 
@@ -225,8 +269,6 @@ def read_nemoh_mesh(path: str) -> np.ndarray:
                         mode = "panels"
                         continue
                     nodes[idx] = [float(parts[1]), float(parts[2]), float(parts[3])]
-                elif len(parts) == 4:
-                    pass
             else:
                 ids = [int(p) for p in parts[:4]]
                 if all(i == 0 for i in ids):

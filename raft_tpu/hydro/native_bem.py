@@ -47,15 +47,22 @@ def _load():
     global _lib
     if _lib is None:
         lib = ctypes.CDLL(_build_lib())
-        lib.bem_solve_deep.restype = ctypes.c_int
-        lib.bem_solve_deep.argtypes = [
+        lib.bem_solve.restype = ctypes.c_int
+        lib.bem_solve.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.c_int,      # panels, np
             ctypes.POINTER(ctypes.c_double), ctypes.c_int,      # w, nw
+            ctypes.c_double,                                    # depth
             ctypes.c_double, ctypes.c_double, ctypes.c_double,  # rho, g, beta
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
             ctypes.c_int,
         ]
+        lib.bem_green_fd.restype = None
+        lib.bem_green_fd.argtypes = [ctypes.c_double] * 5 + [
+            ctypes.POINTER(ctypes.c_double)
+        ]
+        lib.bem_dispersion.restype = ctypes.c_double
+        lib.bem_dispersion.argtypes = [ctypes.c_double, ctypes.c_double]
         lib.bem_wave_integral.restype = None
         lib.bem_wave_integral.argtypes = [ctypes.c_double, ctypes.c_double,
                                           ctypes.POINTER(ctypes.c_double),
@@ -76,16 +83,37 @@ def wave_integral(X: float, Y: float, direct: bool = False):
     return i0.value, i1.value
 
 
+def green_fd(nu: float, depth: float, R: float, zP: float, zQ: float):
+    """Probe the finite-depth Green function (unit tests).
+
+    Returns (G, dG/dR, dG/dz) as complex scalars — the full G including
+    the 1/r and free-surface-image singular parts."""
+    lib = _load()
+    out = (ctypes.c_double * 6)()
+    lib.bem_green_fd(nu, depth, R, zP, zQ, out)
+    return (
+        complex(out[0], out[1]),
+        complex(out[2], out[3]),
+        complex(out[4], out[5]),
+    )
+
+
+def dispersion(nu: float, depth: float) -> float:
+    """k0 with k0 tanh(k0 depth) = nu (native dispersion probe)."""
+    return float(_load().bem_dispersion(nu, depth))
+
+
 def solve_bem(
     panels: np.ndarray,
     w: np.ndarray,
     rho: float = 1025.0,
     g: float = 9.81,
     beta: float = 0.0,
+    depth: float = 0.0,
     nthreads: int = 0,
     cache: bool = True,
 ):
-    """Run the native deep-water BEM solve.
+    """Run the native BEM solve (finite depth when ``depth`` > 0, else deep).
 
     panels: (np, 4, 3) hull mesh (outward normals); w: (nw,) rad/s.
     Returns (A[6,6,nw], B[6,6,nw], F[6,nw] complex), reference-layout arrays
@@ -94,6 +122,7 @@ def solve_bem(
     panels = np.ascontiguousarray(panels, dtype=np.float64)
     w = np.ascontiguousarray(np.atleast_1d(w), dtype=np.float64)
     n_p, n_w = len(panels), len(w)
+    depth = float(depth) if depth and depth > 0 else -1.0
 
     key = None
     if cache:
@@ -102,7 +131,7 @@ def solve_bem(
             h.update(f.read())                # solver edits invalidate cache
         h.update(panels.tobytes())
         h.update(w.tobytes())
-        h.update(np.array([rho, g, beta]).tobytes())
+        h.update(np.array([rho, g, beta, depth]).tobytes())
         key = os.path.join(
             os.path.expanduser("~/.cache/raft_tpu/bem"), h.hexdigest()[:24] + ".npz"
         )
@@ -116,12 +145,12 @@ def solve_bem(
     Fre = np.zeros((n_w, 6))
     Fim = np.zeros((n_w, 6))
     dptr = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
-    ret = lib.bem_solve_deep(
-        dptr(panels), n_p, dptr(w), n_w, rho, g, beta,
+    ret = lib.bem_solve(
+        dptr(panels), n_p, dptr(w), n_w, depth, rho, g, beta,
         dptr(A), dptr(B), dptr(Fre), dptr(Fim), nthreads,
     )
     if ret != 0:
-        raise RuntimeError(f"bem_solve_deep failed with code {ret}")
+        raise RuntimeError(f"bem_solve failed with code {ret}")
     A = A.transpose(1, 2, 0)
     B = B.transpose(1, 2, 0)
     F = (Fre + 1j * Fim).T

@@ -118,17 +118,6 @@ class Model:
         from raft_tpu.hydro.mesh import mesh_design, write_gdf, write_pnl
         from raft_tpu.hydro.native_bem import solve_bem
 
-        k_min = float(np.asarray(self.w)[0]) ** 2 / float(self.env.g)
-        if k_min * self.depth < np.pi:
-            import warnings
-
-            warnings.warn(
-                f"native BEM uses the deep-water Green function, but "
-                f"k*depth = {k_min * self.depth:.2f} < pi at the lowest "
-                f"frequency — low-frequency BEM coefficients are approximate "
-                f"at {self.depth:.0f} m depth",
-                stacklevel=2,
-            )
         with phase("calcBEM"):
             panels = mesh_design(self.design, dz_max=dz_max, da_max=da_max)
             if len(panels) == 0:
@@ -139,10 +128,12 @@ class Model:
                 os.makedirs(out_dir, exist_ok=True)
                 write_pnl(os.path.join(out_dir, "HullMesh.pnl"), panels)
                 write_gdf(os.path.join(out_dir, "platform.gdf"), panels)
+            # finite-depth Green function below k0*depth = 10 (native
+            # solver switches per frequency); deep water beyond
             self.bem = solve_bem(
                 panels, np.asarray(self.w),
                 rho=float(self.env.rho), g=float(self.env.g),
-                beta=float(self.env.beta),
+                beta=float(self.env.beta), depth=self.depth,
             )
         return self.bem
 

@@ -357,6 +357,201 @@ void WaveTable::eval(double X, double Y, double* i0, double* i1) const {
 
 static WaveTable g_table;
 
+// -------------------------------------------------------- finite depth
+//
+// Finite-depth free-surface Green function (e^{i w t}, depth h):
+//   G = 1/r + 1/r2 + Gw,   r2 = seabed image of Q (vertical z+zeta+2h),
+//   Gw = 2 PV Int_0^inf F(mu) sum_i e^{-mu d_i} J0(mu R) dmu
+//        - 2 pi i A0 sum_i e^{-k0 d_i} J0(k0 R),
+//   F(mu) = (mu+nu) / (2[(mu-nu) - (mu+nu) e^{-2 mu h}]),   nu = w^2/g,
+//   d1 = -(z+zeta), d2 = 2h-(z-zeta), d3 = 2h+(z-zeta), d4 = 4h+(z+zeta),
+//   k0: positive root of k tanh(kh) = nu,  A0 = Res_{mu=k0} F.
+// (Derived by expanding cosh mu(z+h) cosh mu(zeta+h) into four
+// exponentials in Wehausen & Laitone eq. 13.19; cross-validated to 8
+// digits against John's eigenfunction series and, in the kh -> inf limit,
+// against the deep-water form above.)
+//
+// Evaluation strategy (Delhommeau-style, per frequency):
+//   2F(mu) = 1 + 2A0/(mu-k0) + rho(mu),  rho smooth and decaying ->
+//   per image i:  "1"    -> 1/sqrt(R^2+d_i^2)        (closed form)
+//                 pole   -> 2 A0 I0(k0 R, -k0 d_i)   (deep-water PV table)
+//                 rho    -> sum_j a_j/sqrt(R^2+(d_i+lam_j)^2)
+// with rho(mu) ~= sum_j a_j e^{-lam_j mu} least-squares fit on a fixed
+// geometric lambda grid (46 terms; fit residual ~1e-6, overall Green
+// function error vs the eigenfunction series ~1e-4 relative for k0h <= 6).
+// For k0 h > 10 the finite-depth corrections are O(e^{-2 k0 h}) < 1e-8 and
+// the deep-water path is used instead.
+//
+// The i=1 "1" term is exactly the free-surface image 1/r1, which the
+// assembly integrates over the panel (Rankine) rather than at centroids;
+// eval() therefore EXCLUDES it, and includes 1/r2 (smooth for floating
+// bodies: vertical distance >= 2(h - draft)).
+
+struct FDGreen {
+    double h = 0, nu = 0, k0 = 0, A0 = 0;
+    bool active = false;
+    static constexpr int NL = 46;
+    double lam[NL], a[NL];
+
+    static double dispersion(double nu, double h) {
+        double k = nu * h < 1.0 ? sqrt(nu / h) : nu;
+        for (int it = 0; it < 100; it++) {
+            double t = tanh(k * h);
+            double c = cosh(k * h);
+            double f = k * t - nu;
+            double df = t + k * h / (c * c);
+            double dk = f / df;
+            k -= dk;
+            if (fabs(dk) < 1e-15 * (k + 1e-300)) break;
+        }
+        return k;
+    }
+
+    void setup(double nu_, double h_) {
+        nu = nu_; h = h_;
+        active = false;
+        if (h <= 0 || nu <= 0) return;
+        k0 = dispersion(nu, h);
+        if (k0 * h >= 10.0) return;                   // deep water regime
+        active = true;
+        double e2 = exp(-2.0 * k0 * h);
+        A0 = (k0 + nu) / (2.0 * (1.0 - e2 + 2.0 * h * (k0 + nu) * e2));
+        // sample rho(mu) = 2F(mu) - 1 - 2A0/(mu-k0) on [0, mumax]
+        const int NS = 1200;
+        double mumax = 20.0 * (k0 > 1.0 / h ? k0 : 1.0 / h);
+        std::vector<double> mu(NS), y(NS);
+        for (int i = 0; i < NS; i++) {
+            double t = (double)i / (NS - 1);
+            double m = mumax * t * t;                 // denser near 0
+            double ref = k0 > 1.0 ? k0 : 1.0;
+            if (fabs(m - k0) < 1e-9 * ref) m += 1e-6 * ref;
+            mu[i] = m;
+            double F = (m + nu) /
+                       (2.0 * ((m - nu) - (m + nu) * exp(-2.0 * m * h)));
+            y[i] = 2.0 * F - 1.0 - 2.0 * A0 / (m - k0);
+        }
+        // geometric lambda grid spanning the decay scales of rho
+        double lmin = (h < 1.0 / k0 ? h : 1.0 / k0) / 50.0;
+        double lmax = 50.0 / (mumax / 20.0);
+        for (int j = 0; j < NL; j++)
+            lam[j] = lmin * pow(lmax / lmin, (double)j / (NL - 1));
+        // least squares via scaled normal equations + tiny ridge
+        std::vector<double> B((size_t)NS * NL);
+        double coln[NL];
+        for (int j = 0; j < NL; j++) {
+            double s2 = 0.0;
+            for (int i = 0; i < NS; i++) {
+                double v = exp(-mu[i] * lam[j]);
+                B[(size_t)i * NL + j] = v;
+                s2 += v * v;
+            }
+            coln[j] = sqrt(s2);
+        }
+        double M[NL][NL], rhs[NL];
+        for (int j = 0; j < NL; j++) {
+            rhs[j] = 0.0;
+            for (int i = 0; i < NS; i++)
+                rhs[j] += B[(size_t)i * NL + j] / coln[j] * y[i];
+            for (int l = 0; l < NL; l++) {
+                double s = 0.0;
+                for (int i = 0; i < NS; i++)
+                    s += B[(size_t)i * NL + j] * B[(size_t)i * NL + l];
+                M[j][l] = s / (coln[j] * coln[l]);
+            }
+            M[j][j] += 1e-10;
+        }
+        // Gaussian elimination with partial pivoting (NL x NL)
+        int piv[NL];
+        for (int j = 0; j < NL; j++) piv[j] = j;
+        for (int c = 0; c < NL; c++) {
+            int p = c; double best = fabs(M[c][c]);
+            for (int i = c + 1; i < NL; i++)
+                if (fabs(M[i][c]) > best) { best = fabs(M[i][c]); p = i; }
+            if (p != c) {
+                for (int j = 0; j < NL; j++) std::swap(M[c][j], M[p][j]);
+                std::swap(rhs[c], rhs[p]);
+            }
+            for (int i = c + 1; i < NL; i++) {
+                double f = M[i][c] / M[c][c];
+                for (int j = c; j < NL; j++) M[i][j] -= f * M[c][j];
+                rhs[i] -= f * rhs[c];
+            }
+        }
+        for (int i = NL - 1; i >= 0; i--) {
+            double s = rhs[i];
+            for (int j = i + 1; j < NL; j++) s -= M[i][j] * a[j];
+            a[i] = s / M[i][i];
+        }
+        for (int j = 0; j < NL; j++) a[j] /= coln[j];
+    }
+
+    // Wave part at field point P=(R horizontal, zP) vs source zQ,
+    // EXCLUDING 1/r and the free-surface image 1/r1, INCLUDING the seabed
+    // image 1/r2.  Returns G and its derivatives w.r.t. R and zP.
+    void eval(double R, double zP, double zQ,
+              cdouble* G, cdouble* dG_dR, cdouble* dG_dz) const {
+        double d[4] = { -(zP + zQ), 2.0 * h - (zP - zQ),
+                        2.0 * h + (zP - zQ), 4.0 * h + (zP + zQ) };
+        static const double sgn[4] = { -1.0, -1.0, 1.0, 1.0 };
+        double gre = 0.0, gre_R = 0.0, gre_z = 0.0;
+        double gim = 0.0, gim_R = 0.0, gim_z = 0.0;
+        double X = k0 * R;
+        double J0 = j0(X), J1 = j1(X);
+        for (int i = 0; i < 4; i++) {
+            double di = d[i], si = sgn[i];
+            // "1" part (skip i=0: that is 1/r1, Rankine-integrated outside)
+            if (i > 0) {
+                double rr2 = R * R + di * di;
+                double rr = sqrt(rr2);
+                double t3 = 1.0 / (rr2 * rr);
+                gre += 1.0 / rr;
+                gre_R += -R * t3;
+                gre_z += -di * t3 * si;
+            }
+            // pole part: 2 A0 I0(k0 R, -k0 d_i)
+            {
+                double Y = -k0 * di;
+                double i0, i1;
+                g_table.eval(X, Y, &i0, &i1);
+                double rxy = sqrt(X * X + Y * Y);
+                if (rxy < 1e-12) rxy = 1e-12;
+                double C1 = X > 1e-12 ? (1.0 / X) * (1.0 - (-Y) / rxy) : 0.0;
+                gre += 2.0 * A0 * i0;
+                gre_R += 2.0 * A0 * k0 * (-(C1 + i1));
+                gre_z += 2.0 * A0 * (-k0 * si) * (1.0 / rxy + i0);
+            }
+            // exp-fit part
+            for (int j = 0; j < NL; j++) {
+                double c = di + lam[j];
+                double rr2 = R * R + c * c;
+                double rr = sqrt(rr2);
+                double t3 = a[j] / (rr2 * rr);
+                gre += a[j] / rr;
+                gre_R += -R * t3;
+                gre_z += -c * t3 * si;
+            }
+            // imaginary (radiated-wave) part
+            double e = exp(-k0 * di);
+            gim += -2.0 * PI * A0 * e * J0;
+            gim_R += 2.0 * PI * A0 * k0 * e * J1;
+            gim_z += 2.0 * PI * A0 * k0 * si * e * J0;
+        }
+        // seabed image 1/r2 (vertical zP + zQ + 2h; d(v2)/dzP = +1)
+        {
+            double v2 = zP + zQ + 2.0 * h;
+            double rr2 = R * R + v2 * v2;
+            double rr = sqrt(rr2);
+            double t3 = 1.0 / (rr2 * rr);
+            gre += 1.0 / rr;
+            gre_R += -R * t3;
+            gre_z += -v2 * t3;
+        }
+        *G = cdouble(gre, gim);
+        *dG_dR = cdouble(gre_R, gim_R);
+        *dG_dz = cdouble(gre_z, gim_z);
+    }
+};
+
 // ------------------------------------------------------------- geometry
 
 struct Panel {
@@ -477,7 +672,8 @@ static void wave_part(double k, const double* P, const double* Q,
     gradP[2] = dG_dv;
 }
 
-static void assemble(const std::vector<Panel>& pan, double k, Influence& inf) {
+static void assemble(const std::vector<Panel>& pan, double k,
+                     const FDGreen* fd, Influence& inf) {
     int n = (int)pan.size();
     inf.S.assign((size_t)n * n, 0.0);
     inf.D.assign((size_t)n * n, 0.0);
@@ -514,9 +710,22 @@ static void assemble(const std::vector<Panel>& pan, double k, Influence& inf) {
                        : rel < 6.0 ? 3 : 1;
                 rankine_integral(qi, P, ns, &potI, gradI);
             }
-            // wave part at centroids (smooth)
+            // wave part at centroids (smooth); finite depth adds the
+            // seabed image and evanescent-mode corrections
             cdouble Gw, gw[3];
-            wave_part(k, P, q.c, &Gw, gw);
+            if (fd && fd->active) {
+                double R = sqrt(dx * dx + dy * dy);
+                cdouble G, dGdR, dGdz;
+                fd->eval(R, P[2], q.c[2], &G, &dGdR, &dGdz);
+                double ux = R > 1e-12 ? dx / R : 0.0;
+                double uy = R > 1e-12 ? dy / R : 0.0;
+                Gw = G;
+                gw[0] = dGdR * ux;
+                gw[1] = dGdR * uy;
+                gw[2] = dGdz;
+            } else {
+                wave_part(k, P, q.c, &Gw, gw);
+            }
             cdouble S = pot + potI + Gw * q.area;
             cdouble Dn = (grad[0] + gradI[0] + gw[0] * q.area) * pan[i].n[0]
                        + (grad[1] + gradI[1] + gw[1] * q.area) * pan[i].n[1]
@@ -570,14 +779,14 @@ static int lu_solve(std::vector<cdouble>& A, std::vector<cdouble>& B, int n, int
 
 extern "C" {
 
-// panels: np x 4 x 3 (row-major); w: nw angular frequencies.
-// Outputs (row-major): A, Bo: nw x 6 x 6; Fre, Fim: nw x 6.
-// Returns 0 on success.
-int bem_solve_deep(const double* panels, int np,
-                   const double* w, int nw,
-                   double rho, double g, double beta,
-                   double* A, double* Bo, double* Fre, double* Fim,
-                   int nthreads) {
+// panels: np x 4 x 3 (row-major); w: nw angular frequencies; depth <= 0
+// means infinite depth (deep water).  Outputs (row-major): A, Bo:
+// nw x 6 x 6; Fre, Fim: nw x 6.  Returns 0 on success.
+int bem_solve(const double* panels, int np,
+              const double* w, int nw, double depth,
+              double rho, double g, double beta,
+              double* A, double* Bo, double* Fre, double* Fim,
+              int nthreads) {
 #ifdef _OPENMP
     if (nthreads > 0) omp_set_num_threads(nthreads);
 #endif
@@ -592,9 +801,24 @@ int bem_solve_deep(const double* panels, int np,
     int n = np;
     for (int iw = 0; iw < nw; iw++) {
         double om = w[iw];
-        double k = om * om / g;
+        double k = om * om / g;                       // nu (deep wavenumber)
+        FDGreen fd;
+        fd.setup(k, depth);
+        // incident wave number and stable depth-profile factors:
+        //   Zr = cosh(kw(z+h))/cosh(kw h),  Zs = sinh(kw(z+h))/cosh(kw h)
+        double kw = fd.active ? fd.k0 : k;
+        auto Zr = [&](double z) {
+            if (!fd.active) return exp(kw * z);
+            double e = exp(-2.0 * kw * (z + depth));
+            return exp(kw * z) * (1.0 + e) / (1.0 + exp(-2.0 * kw * depth));
+        };
+        auto Zs = [&](double z) {
+            if (!fd.active) return exp(kw * z);
+            double e = exp(-2.0 * kw * (z + depth));
+            return exp(kw * z) * (1.0 - e) / (1.0 + exp(-2.0 * kw * depth));
+        };
         Influence inf;
-        assemble(pan, k, inf);
+        assemble(pan, k, fd.active ? &fd : nullptr, inf);
         // system: (-2 pi I + D) sigma = rhs, 7 RHS (6 radiation + diffraction)
         // -- exterior limit with the collocation normal pointing INTO the
         // fluid gives the jump  d(phi)/dn -> -2 pi sigma + PV D sigma
@@ -615,14 +839,16 @@ int bem_solve_deep(const double* panels, int np,
             };
             for (int kk = 0; kk < 6; kk++) rhs[(size_t)i * m + kk] = nvec[kk];
             // incident wave (unit amplitude, e^{iwt}):
-            //   phi_I = (g/om) * i * e^{kz} e^{-ik(x cos b + y sin b)}
-            cdouble ph = cdouble(0.0, g / om)
-                       * exp(k * rz)
-                       * std::exp(cdouble(0.0, -k * (rx * cos(beta) + ry * sin(beta))));
+            //   phi_I = (g/om) i Zr(z) e^{-i kw (x cos b + y sin b)}
+            // deep water: Zr = Zs = e^{kw z}; finite depth: cosh/sinh
+            // profile over the water column (kw = k0)
+            cdouble phase = std::exp(
+                cdouble(0.0, -kw * (rx * cos(beta) + ry * sin(beta))));
+            cdouble ph = cdouble(0.0, g / om) * Zr(rz) * phase;
             // grad phi_I
-            cdouble ddx = ph * cdouble(0.0, -k * cos(beta));
-            cdouble ddy = ph * cdouble(0.0, -k * sin(beta));
-            cdouble ddz = ph * k;
+            cdouble ddx = ph * cdouble(0.0, -kw * cos(beta));
+            cdouble ddy = ph * cdouble(0.0, -kw * sin(beta));
+            cdouble ddz = cdouble(0.0, g / om) * kw * Zs(rz) * phase;
             rhs[(size_t)i * m + 6] =
                 -(ddx * p.n[0] + ddy * p.n[1] + ddz * p.n[2]);
         }
@@ -661,9 +887,8 @@ int bem_solve_deep(const double* panels, int np,
                 cdouble phiS = 0.0;
                 for (int q = 0; q < n; q++)
                     phiS += inf.S[(size_t)i * n + q] * rhs[(size_t)q * m + 6];
-                cdouble phiI = cdouble(0.0, g / om)
-                             * exp(k * p.c[2])
-                             * std::exp(cdouble(0.0, -k * (p.c[0] * cos(beta) + p.c[1] * sin(beta))));
+                cdouble phiI = cdouble(0.0, g / om) * Zr(p.c[2])
+                             * std::exp(cdouble(0.0, -kw * (p.c[0] * cos(beta) + p.c[1] * sin(beta))));
                 double nvec[6] = {
                     p.n[0], p.n[1], p.n[2],
                     p.c[1] * p.n[2] - p.c[2] * p.n[1],
@@ -679,6 +904,58 @@ int bem_solve_deep(const double* panels, int np,
         }
     }
     return 0;
+}
+
+// backward-compatible deep-water entry
+int bem_solve_deep(const double* panels, int np,
+                   const double* w, int nw,
+                   double rho, double g, double beta,
+                   double* A, double* Bo, double* Fre, double* Fim,
+                   int nthreads) {
+    return bem_solve(panels, np, w, nw, -1.0, rho, g, beta,
+                     A, Bo, Fre, Fim, nthreads);
+}
+
+// finite-depth Green function probe for unit tests: returns the FULL
+// G = 1/r + 1/r1 + (wave part incl. 1/r2) and its gradient w.r.t. the
+// field point (dR, dz).  out = [Gre, Gim, dGdR_re, dGdR_im, dGdz_re,
+// dGdz_im].  Falls back to the deep-water form when k0*depth >= 10.
+void bem_green_fd(double nu, double depth, double R, double zP, double zQ,
+                  double* out) {
+    g_table.build();
+    FDGreen fd;
+    fd.setup(nu, depth);
+    cdouble G, dGdR, dGdz;
+    if (fd.active) {
+        fd.eval(R, zP, zQ, &G, &dGdR, &dGdz);
+        // add the direct and free-surface-image Rankine terms
+        double dz_d = zP - zQ, dz_i = zP + zQ;
+        double r2d = R * R + dz_d * dz_d, r2i = R * R + dz_i * dz_i;
+        double rd = sqrt(r2d), ri = sqrt(r2i);
+        G += 1.0 / rd + 1.0 / ri;
+        dGdR += -R / (r2d * rd) - R / (r2i * ri);
+        dGdz += -dz_d / (r2d * rd) - dz_i / (r2i * ri);
+    } else {
+        double P[3] = { R, 0.0, zP }, Q[3] = { 0.0, 0.0, zQ };
+        cdouble gw[3];
+        wave_part(nu, P, Q, &G, gw);
+        dGdR = gw[0];
+        dGdz = gw[2];
+        double dz_d = zP - zQ, dz_i = zP + zQ;
+        double r2d = R * R + dz_d * dz_d, r2i = R * R + dz_i * dz_i;
+        double rd = sqrt(r2d), ri = sqrt(r2i);
+        G += 1.0 / rd + 1.0 / ri;
+        dGdR += -R / (r2d * rd) - R / (r2i * ri);
+        dGdz += -dz_d / (r2d * rd) - dz_i / (r2i * ri);
+    }
+    out[0] = G.real(); out[1] = G.imag();
+    out[2] = dGdR.real(); out[3] = dGdR.imag();
+    out[4] = dGdz.real(); out[5] = dGdz.imag();
+}
+
+// dispersion probe: k0 with k0 tanh(k0 h) = nu
+double bem_dispersion(double nu, double depth) {
+    return FDGreen::dispersion(nu, depth);
 }
 
 // probe Phi(zeta) for unit tests

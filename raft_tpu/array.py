@@ -1,0 +1,275 @@
+"""Multi-turbine arrays: FOWTs stacked on a leading device axis.
+
+The reference is architecturally N-turbine — ``Model.fowtList`` grows by
+``addFOWT`` and ``nDOF += 6`` per FOWT (raft/raft.py:1292-1298) — but every
+solve method hard-wires ``fowtList[0]``, so arrays never actually run there.
+Here the array is a first-class batched axis: each turbine's padded
+:class:`~raft_tpu.core.types.MemberSet`/RNA is stacked on a leading axis and
+the whole device pipeline (statics, strip hydro, drag-linearized RAO fixed
+point) runs under one ``jax.vmap`` — N turbines cost one fused kernel, and
+the same leading axis shards over a TPU mesh for large wind farms.
+
+Physics scope matches the reference architecture: turbines are
+hydrodynamically independent (no wave-interaction coupling between hulls —
+the reference has none either), each with its own mooring system, sharing
+one incident wave field.  A turbine at plan position (x, y) sees the
+incident wave with phase lag ``exp(-i k (x cos beta + y sin beta))``; the
+phase multiplies the wave kinematics at its strip nodes so excitation AND
+drag linearization inherit it consistently.  The coupled system matrices are
+therefore block-diagonal and the 6N-DOF response is the stacked per-turbine
+response — which the block-diagonality test in tests/test_array.py verifies
+against single-turbine runs.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.build.members import build_member_set, build_rna
+from raft_tpu.core.cplx import Cx
+from raft_tpu.core.types import Env, WaveState
+from raft_tpu.core.waves import jonswap, wave_number
+from raft_tpu.hydro import node_kinematics, strip_added_mass, strip_excitation
+from raft_tpu.hydro.strip import StripKin
+from raft_tpu.mooring import (
+    fairlead_tensions,
+    mooring_force,
+    mooring_stiffness,
+    parse_mooring,
+    solve_equilibrium,
+)
+from raft_tpu.solve import LinearCoeffs, diagonal_estimates, solve_dynamics, solve_eigen
+from raft_tpu.statics import assemble_statics
+from raft_tpu.utils.profiling import phase
+
+Array = jnp.ndarray
+
+
+def stack_fowts(designs: list[dict]):
+    """Build each design's member set with shared pad dims and stack them.
+
+    Returns (members_stacked, rna_stacked) — every leaf gains a leading
+    turbine axis, so the single-FOWT kernels run under ``jax.vmap``.
+    """
+    base = [build_member_set(d) for d in designs]
+    S = max(int(m.seg_mask.shape[0]) for m in base)
+    N = max(int(m.node_mask.shape[0]) for m in base)
+    sets = [build_member_set(d, pad_segments=S, pad_nodes=N) for d in designs]
+    members = jax.tree.map(lambda *xs: jnp.stack(xs), *sets)
+    rnas = [build_rna(d) for d in designs]
+    rna = jax.tree.map(lambda *xs: jnp.stack([jnp.asarray(x) for x in xs]), *rnas)
+    return members, rna
+
+
+def _phase_kin(kin: StripKin, ph: Cx) -> StripKin:
+    """Multiply node wave kinematics by a per-frequency phase factor (nw,)."""
+    ph3 = Cx(ph.re[None, :, None], ph.im[None, :, None])
+    ph2 = Cx(ph.re[None, :], ph.im[None, :])
+    return StripKin(u=kin.u * ph3, ud=kin.ud * ph3, pDyn=kin.pDyn * ph2)
+
+
+class ArrayModel:
+    """N mooring-coupled FOWTs analyzed as one stacked batch (nDOF = 6N).
+
+    ``designs``: one design dict (replicated ``nT`` times) or a list of
+    design dicts.  ``positions``: (nT, 2) plan coordinates of each turbine's
+    PRP; defaults to all-zero (co-located, useful for verification).
+    """
+
+    def __init__(self, designs, positions=None, w=None, depth: float | None = None,
+                 nT: int | None = None):
+        if isinstance(designs, dict):
+            if nT is None:
+                nT = len(positions) if positions is not None else 1
+            designs = [designs] * nT
+        self.designs = list(designs)
+        self.nT = len(self.designs)
+        if positions is None:
+            positions = np.zeros((self.nT, 2))
+        self.positions = np.asarray(positions, dtype=float).reshape(self.nT, 2)
+        self.members, self.rna = stack_fowts(self.designs)
+        self.moor = []
+        for d in self.designs:
+            mo = d.get("mooring")
+            ys = float(d.get("turbine", {}).get("yaw_stiffness", 0.0))
+            self.moor.append(parse_mooring(mo, yaw_stiffness=ys) if mo else None)
+        if depth is None:
+            m0 = self.designs[0].get("mooring")
+            depth = float(m0.get("water_depth", 300.0)) if m0 else 300.0
+        self.depth = float(depth)
+        if w is None:
+            w = np.arange(0.05, 3.0, 0.05)
+        self.w = jnp.asarray(np.asarray(w, dtype=float))
+        self.env = Env(depth=self.depth)
+        self.wave: WaveState | None = None
+        self.statics = None
+        self.kin = None
+        self.A_morison = None
+        self.F_morison = None
+        self.C_moor0 = None
+        self.C_moor = None
+        self.r6_eq = None
+        self.rao = None
+        self.results: dict = {}
+
+    # ---------------------------------------------------------------- env
+
+    def setEnv(self, Hs=8.0, Tp=12.0, V=10.0, beta=0.0, Fthrust=0.0):
+        self.env = Env(Hs=float(Hs), Tp=float(Tp), V=float(V), beta=float(beta),
+                       depth=self.depth)
+        S = jonswap(self.w, Hs, Tp)
+        k = wave_number(self.w, self.depth)
+        self.wave = WaveState(w=self.w, k=k, zeta=jnp.sqrt(S))
+        # incident-wave phase lag at each turbine's PRP
+        d_along = (self.positions[:, 0] * np.cos(beta)
+                   + self.positions[:, 1] * np.sin(beta))
+        theta = -jnp.asarray(d_along)[:, None] * k[None, :]     # (nT, nw)
+        self.phases = Cx.expi(theta)
+        self.Fthrust = float(Fthrust)
+        hubs = np.asarray(self.rna.hHub).reshape(self.nT)
+        self.f6Ext = jnp.stack([
+            jnp.array([self.Fthrust, 0, 0, 0, self.Fthrust * h, 0]) for h in hubs
+        ])
+        return self
+
+    # ------------------------------------------------------------- statics
+
+    def calcSystemProps(self):
+        if self.wave is None:
+            self.setEnv()
+        env, wave = self.env, self.wave
+        with phase("array-statics"):
+            self.statics = jax.vmap(lambda m, r: assemble_statics(m, r, env))(
+                self.members, self.rna
+            )
+        with phase("array-hydro-strip"):
+            kin0 = jax.vmap(lambda m: node_kinematics(m, wave, env))(self.members)
+            self.kin = jax.vmap(_phase_kin)(kin0, self.phases)
+            self.A_morison = jax.vmap(lambda m: strip_added_mass(m, env))(self.members)
+            self.F_morison = jax.vmap(
+                lambda m, k: strip_excitation(m, k, env)
+            )(self.members, self.kin)
+        with phase("array-mooring-stiffness"):
+            z6 = jnp.zeros(6)
+            C0 = [
+                mooring_stiffness(mo, z6) if mo is not None else jnp.zeros((6, 6))
+                for mo in self.moor
+            ]
+            self.C_moor0 = jnp.stack(C0)
+        self.C_moor = self.C_moor0
+        self.results["properties"] = {
+            "n turbines": self.nT,
+            "nDOF": 6 * self.nT,
+            "total mass": np.asarray(self.statics.mass),
+            "displacement": np.asarray(self.statics.V),
+            "total CG": np.asarray(self.statics.rCG),
+        }
+        return self
+
+    # --------------------------------------------------------------- eigen
+
+    def solveEigen(self):
+        """Block-diagonal 6N eigenproblem = N independent 6x6 problems."""
+        if self.statics is None:
+            self.calcSystemProps()
+        M_tot = self.statics.M_struc + self.A_morison
+        C_tot = self.statics.C_struc + self.statics.C_hydro + self.C_moor0
+        with phase("array-eigen"):
+            eig = jax.vmap(solve_eigen)(M_tot, C_tot)
+            est = jax.vmap(diagonal_estimates)(M_tot, C_tot)
+        self.eigen = eig
+        fns = np.asarray(eig.fns)                          # (nT, 6)
+        self.results["eigen"] = {
+            "frequencies": fns,
+            "periods": 1.0 / np.maximum(fns, 1e-12),
+            "modes": np.asarray(eig.modes),
+            "estimates": np.asarray(est),
+        }
+        return self
+
+    # ------------------------------------------------------------- mooring
+
+    def calcMooringAndOffsets(self):
+        if self.statics is None:
+            self.calcSystemProps()
+        s = self.statics
+        r6s, Cs, Ts, res = [], [], [], []
+        with phase("array-mooring-equilibrium"):
+            for i, mo in enumerate(self.moor):
+                if mo is None:
+                    r6s.append(jnp.zeros(6))
+                    Cs.append(jnp.zeros((6, 6)))
+                    Ts.append(jnp.zeros(0))
+                    res.append(0.0)
+                    continue
+                F_const = s.W_struc[i] + s.W_hydro[i] + self.f6Ext[i]
+                C_body = s.C_struc[i] + s.C_hydro[i]
+                r6, r = solve_equilibrium(mo, F_const, C_body)
+                r6s.append(r6)
+                Cs.append(mooring_stiffness(mo, r6))
+                Ts.append(fairlead_tensions(mo, r6))
+                res.append(float(r))
+        self.r6_eq = jnp.stack(r6s)
+        self.C_moor = jnp.stack(Cs)
+        self.results["means"] = {
+            "platform offset": np.asarray(self.r6_eq),        # (nT, 6)
+            "equilibrium residual": np.asarray(res),
+            "fairlead tensions": [np.asarray(t) for t in Ts],
+        }
+        return self
+
+    # ------------------------------------------------------------ dynamics
+
+    def solveDynamics(self, nIter: int = 40, tol: float = 0.01, method="while"):
+        if self.statics is None:
+            self.calcSystemProps()
+        if self.C_moor is None:
+            self.C_moor = self.C_moor0
+        env, wave = self.env, self.wave
+        nw = self.w.shape[0]
+        s = self.statics
+
+        def lane(members, kin, A_mor, F_mor, M_struc, C_struc, C_hydro, C_moor):
+            lin = LinearCoeffs(
+                M=jnp.broadcast_to(M_struc + A_mor, (nw, 6, 6)),
+                B=jnp.zeros((nw, 6, 6), dtype=A_mor.dtype),
+                C=C_struc + C_hydro + C_moor,
+                F=F_mor,
+            )
+            return solve_dynamics(members, kin, wave, env, lin,
+                                  n_iter=nIter, tol=tol, method=method)
+
+        with phase("array-rao-solve"):
+            self.rao = jax.vmap(lane)(
+                self.members, self.kin, self.A_morison, self.F_morison,
+                s.M_struc, s.C_struc, s.C_hydro, self.C_moor,
+            )
+        Xi = self.rao.Xi                                     # (nT, nw, 6)
+        amp = np.asarray(Xi.abs())
+        zeta = np.maximum(np.asarray(wave.zeta), 1e-12)
+        dw = float(self.w[1] - self.w[0]) if nw > 1 else 1.0
+        sigma = np.sqrt((amp**2).sum(axis=1) * dw)           # (nT, 6)
+        Xi_c = np.asarray(Xi.to_complex())                   # (nT, nw, 6)
+        self.results["response"] = {
+            "w": np.asarray(self.w),
+            "Xi": np.transpose(Xi_c, (1, 0, 2)).reshape(nw, 6 * self.nT),
+            "Xi per turbine": Xi_c,
+            "RAO magnitude": amp / zeta[None, :, None],
+            "std dev": sigma,
+            "converged": np.asarray(self.rao.converged),
+            "iterations": np.asarray(self.rao.n_iter),
+        }
+        return self
+
+    def calcOutputs(self):
+        if self.rao is None:
+            raise RuntimeError("run solveDynamics first")
+        w = np.asarray(self.w)
+        Xi = self.results["response"]["Xi per turbine"]      # (nT, nw, 6)
+        hubs = np.asarray(self.rna.hHub).reshape(self.nT)
+        a_nac = -(w[None, :] ** 2) * (Xi[:, :, 0] + Xi[:, :, 4] * hubs[:, None])
+        zeta = np.maximum(np.asarray(self.wave.zeta), 1e-12)
+        self.results["response"]["nacelle acceleration"] = a_nac
+        self.results["response"]["nacelle acceleration RAO"] = np.abs(a_nac) / zeta
+        return self.results

@@ -47,11 +47,32 @@ class Model:
     raft/raft.py:1272); ``depth`` the water depth override.
     """
 
-    def __init__(self, design: dict, w=None, depth: float | None = None,
-                 nTurbines: int = 1, BEM=None,
-                 pad_segments: int | None = None, pad_nodes: int | None = None):
+    def __new__(cls, design: dict = None, w=None, depth: float | None = None,
+                nTurbines: int = 1, BEM=None, positions=None,
+                pad_segments: int | None = None, pad_nodes: int | None = None):
+        # N-turbine construction returns the stacked-axis ArrayModel (the
+        # reference accepts nTurbines but hard-wires fowtList[0],
+        # raft/raft.py:1292-1298; here arrays actually solve as 6N DOF)
         if nTurbines != 1:
-            raise NotImplementedError("multi-turbine arrays not yet supported")
+            if BEM is not None:
+                raise NotImplementedError(
+                    "BEM coefficients are not yet supported for multi-turbine "
+                    "arrays; run single-turbine models with BEM, or arrays "
+                    "strip-theory-only"
+                )
+            from raft_tpu.array import ArrayModel
+
+            if positions is None:
+                positions = (design or {}).get("array", {}).get("positions")
+            return ArrayModel(design, positions=positions, w=w, depth=depth,
+                              nT=nTurbines)
+        return super().__new__(cls)
+
+    def __init__(self, design: dict, w=None, depth: float | None = None,
+                 nTurbines: int = 1, BEM=None, positions=None,
+                 pad_segments: int | None = None, pad_nodes: int | None = None):
+        if positions is not None:
+            raise ValueError("positions is only meaningful with nTurbines > 1")
         self.design = design
         self.members = build_member_set(
             design, pad_segments=pad_segments, pad_nodes=pad_nodes
@@ -220,24 +241,72 @@ class Model:
 
     # --------------------------------------------------------------- eigen
 
-    def solveEigen(self):
-        """Natural frequencies (cf. Model.solveEigen, raft/raft.py:1370-1452)."""
+    def solveEigen(self, n_pass: int = 3):
+        """Natural frequencies (cf. Model.solveEigen, raft/raft.py:1370-1452).
+
+        With BEM coefficients staged, the frequency-dependent added mass is
+        evaluated *at each mode's own natural frequency* by a small fixed
+        point: solve with A(w_n) interpolated per mode, update w_n, repeat
+        ``n_pass`` times (converges in 2-3 passes — A(w) varies slowly near
+        the rigid-body modes).  The reference cannot do this: its BEM arrays
+        are always zero (raft/raft.py:1380,1797-1800).
+
+        Also reports the reference's per-DOF diagonal estimates with
+        CG/mooring z-lever corrections (raft/raft.py:1422-1446) as the
+        ``estimates`` key — the engineering cross-check output.
+        """
         if self.statics is None:
             self.calcSystemProps()
-        M_tot = self.statics.M_struc + self.A_morison
-        if self.bem is not None:
-            # potMod members are gated out of A_morison; use their BEM added
-            # mass at the lowest frequency (the rigid-body modes are all
-            # low-frequency).  The reference uses A_hydro_morison only
-            # (raft/raft.py:1380) because its BEM arrays are always zero.
-            M_tot = M_tot + jnp.asarray(np.asarray(self.bem[0])[:, :, 0])
+        from raft_tpu.solve import diagonal_estimates
+        import jax
+
+        M_base = self.statics.M_struc + self.A_morison
         C_tot = self.statics.C_struc + self.statics.C_hydro + self.C_moor0
         with phase("eigen"):
-            self.eigen = solve_eigen(M_tot, C_tot)
+            if self.bem is None:
+                self.eigen = solve_eigen(M_base, C_tot)
+                fns = np.asarray(self.eigen.fns)
+                modes = np.asarray(self.eigen.modes)
+                est = np.asarray(diagonal_estimates(M_base, C_tot))
+            else:
+                # per-mode A_bem(w_n) fixed point: mode i's frequency comes
+                # from the eigenproblem assembled with A interpolated at
+                # mode i's current natural frequency
+                A_w = np.moveaxis(np.asarray(self.bem[0]), -1, 0)  # (nw,6,6)
+                wg = np.asarray(self.w)
+                wns = np.full(6, wg[0])
+                solve6 = jax.jit(jax.vmap(solve_eigen, in_axes=(0, None)))
+                for _ in range(n_pass):
+                    A_modes = np.empty((6, 6, 6))
+                    for a in range(6):
+                        for b in range(6):
+                            A_modes[:, a, b] = np.interp(wns, wg, A_w[:, a, b])
+                    eigs = solve6(jnp.asarray(M_base + A_modes), C_tot)
+                    wns = np.asarray(eigs.wns)[np.arange(6), np.arange(6)]
+                # reduce the 6-assembly batch to one flat per-DOF result so
+                # self.eigen has the same shape with or without BEM staged
+                from raft_tpu.solve import EigenResult
+
+                self.eigen = EigenResult(
+                    fns=jnp.asarray(wns / (2.0 * np.pi)),
+                    wns=jnp.asarray(wns),
+                    modes=jnp.stack(
+                        [eigs.modes[i, :, i] for i in range(6)], axis=1
+                    ),
+                    order=jnp.stack([eigs.order[i, i] for i in range(6)]),
+                )
+                fns = np.asarray(self.eigen.fns)
+                modes = np.asarray(self.eigen.modes)
+                est = np.asarray(
+                    jax.vmap(diagonal_estimates, in_axes=(0, None))(
+                        jnp.asarray(M_base + A_modes), C_tot
+                    )
+                )[np.arange(6), np.arange(6)]
         self.results["eigen"] = {
-            "frequencies": np.asarray(self.eigen.fns),
-            "periods": np.asarray(1.0 / np.maximum(self.eigen.fns, 1e-12)),
-            "modes": np.asarray(self.eigen.modes),
+            "frequencies": fns,
+            "periods": np.asarray(1.0 / np.maximum(fns, 1e-12)),
+            "modes": modes,
+            "estimates": est,
         }
         return self
 

@@ -11,16 +11,24 @@ Fully suspended (vertical anchor tension V - wL >= 0):
   xf = (H/w)[asinh(V/H) - asinh((V-wL)/H)] + H L/EA
   zf = (H/w)[sqrt(1+(V/H)^2) - sqrt(1+((V-wL)/H)^2)] + (V L - w L^2/2)/EA
 
-Seabed contact (V < wL; resting length LB = L - V/w, zero seabed friction):
-  xf = L - V/w + (H/w) asinh(V/H) + H L/EA
+Seabed contact (V < wL; resting length LB = L - V/w):
+  xf = LB + (H/w) asinh(V/H) + H L/EA + friction term
   zf = (H/w)[sqrt(1+(V/H)^2) - 1] + V^2/(2 EA w)
+
+Seabed friction (coefficient CB, per MAP/MoorPy): along the grounded
+portion the horizontal tension decays from H at touchdown at rate CB*w per
+unit length, so the anchor-end tension is Ha = max(H - CB*w*LB, 0); if it
+reaches zero at x0 = LB - H/(CB*w) > 0 the segment [0, x0] is slack.  Only
+the elastic stretch of the grounded portion changes: integral of T ds is
+  H*LB - CB*w*LB^2/2          (tension positive all along, x0 <= 0)
+  H^2/(2*CB*w)                (slack segment present,    x0 > 0)
+which folds into the xf residual as
+  (CB*w/(2*EA)) * (x0*max(x0,0) - LB^2)
+added to the frictionless H*L/EA term (exactly 0 as CB -> 0).
 
 The branch is selected per-iteration with ``jnp.where`` so the whole solve is
 shape-static, vmappable over a line batch, and differentiable (fixed Newton
 iteration count; gradients flow through the converged iterates).
-
-Deviation from MoorPy noted in DEVIATIONS.md: seabed friction coefficient CB
-is treated as zero.
 """
 from __future__ import annotations
 
@@ -40,6 +48,7 @@ class LineProps:
     L: Array      # unstretched length [m]
     w: Array      # submerged weight per unit length [N/m]
     EA: Array     # axial stiffness [N]
+    CB: Array = 0.0  # seabed friction coefficient [-] (MAP/MoorPy convention)
 
 
 @struct.dataclass
@@ -68,7 +77,17 @@ def _profile_residual(H: Array, V: Array, xf: Array, zf: Array, p: LineProps):
     z_susp = (H / w) * (sq_f - sq_a) + (V * L - 0.5 * w * L * L) / EA
 
     LB = jnp.clip(L - V / w, 0.0, None)
-    x_td = LB + (H / w) * jnp.arcsinh(s_f) + H * L / EA
+    # seabed friction: stretch of the grounded portion under linearly
+    # decaying tension (double-where so CB=0 stays NaN-free under grad)
+    cbw = p.CB * w
+    cbw_safe = jnp.where(cbw > 0, cbw, 1.0)
+    x0 = LB - H / cbw_safe
+    fric = jnp.where(
+        cbw > 0,
+        (cbw / (2.0 * EA)) * (x0 * jnp.clip(x0, 0.0, None) - LB * LB),
+        0.0,
+    )
+    x_td = LB + (H / w) * jnp.arcsinh(s_f) + H * L / EA + fric
     z_td = (H / w) * (sq_f - 1.0) + V * V / (2.0 * EA * w)
 
     rx = jnp.where(touchdown, x_td, x_susp) - xf
@@ -121,10 +140,18 @@ def solve_catenary(
     rx, rz = _profile_residual(H, V, xf, zf, p)
     Va = jnp.clip(V - p.w * p.L, 0.0, None)
     LB = jnp.clip(p.L - V / p.w, 0.0, None)
+    # anchor-end horizontal tension is reduced by seabed friction over LB
+    Ha = jnp.where(
+        V < p.w * p.L, jnp.clip(H - p.CB * p.w * LB, 0.0, None), H
+    )
+    # double-where sqrt guard: a fully slack anchor (Ha = Va = 0, possible
+    # with friction) must give Ta = 0 with zero — not NaN — gradient
+    Ta2 = Ha * Ha + Va * Va
+    Ta = jnp.where(Ta2 > 0, jnp.sqrt(jnp.where(Ta2 > 0, Ta2, 1.0)), 0.0)
     return CatenaryState(
         H=H,
         V=V,
-        Ta=jnp.sqrt(H * H + Va * Va),
+        Ta=Ta,
         Tf=jnp.sqrt(H * H + V * V),
         LB=LB,
         residual=jnp.maximum(jnp.abs(rx), jnp.abs(rz)),

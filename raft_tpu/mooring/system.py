@@ -54,7 +54,7 @@ def parse_mooring(mooring: dict, rho: float = 1025.0, g: float = 9.81,
     """
     pts = {p["name"]: p for p in mooring["points"]}
     types = {t["name"]: t for t in mooring["line_types"]}
-    anchors, fairs, Ls, ws, EAs = [], [], [], [], []
+    anchors, fairs, Ls, ws, EAs, CBs = [], [], [], [], [], []
     for ln in mooring["lines"]:
         a, b = pts[ln["endA"]], pts[ln["endB"]]
         if a["type"] == "vessel":                 # normalize: A = anchor side
@@ -71,6 +71,7 @@ def parse_mooring(mooring: dict, rho: float = 1025.0, g: float = 9.81,
         d = float(t["diameter"])
         ws.append(g * (m_lin - rho * np.pi / 4.0 * d * d))
         EAs.append(float(t["stiffness"]))
+        CBs.append(float(t.get("seabed_friction", t.get("cb", 0.0))))
     return MooringSystem(
         r_anchor=jnp.asarray(np.array(anchors, dtype=float)),
         r_fair_body=jnp.asarray(np.array(fairs, dtype=float)),
@@ -78,6 +79,7 @@ def parse_mooring(mooring: dict, rho: float = 1025.0, g: float = 9.81,
             L=jnp.asarray(Ls, dtype=float),
             w=jnp.asarray(ws, dtype=float),
             EA=jnp.asarray(EAs, dtype=float),
+            CB=jnp.asarray(CBs, dtype=float),
         ),
         depth=jnp.asarray(float(mooring.get("water_depth", 300.0))),
         yaw_stiffness=jnp.asarray(float(yaw_stiffness)),

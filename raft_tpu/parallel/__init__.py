@@ -5,5 +5,6 @@ from raft_tpu.parallel.sweep import (  # noqa: F401
     make_mesh,
     response_std,
     scale_diameters,
+    stage_bem,
     sweep,
 )

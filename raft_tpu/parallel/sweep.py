@@ -46,28 +46,65 @@ def scale_diameters(members: MemberSet, scale: Array) -> MemberSet:
     )
 
 
+def stage_bem(bem, wave: WaveState):
+    """Host-layout BEM coefficients -> device arrays for the sweep.
+
+    ``bem`` is the native-solver / WAMIT-reader layout (A[6,6,nw], B[6,6,nw],
+    F[6,nw] complex, per unit wave amplitude).  Returns (A[nw,6,6],
+    B[nw,6,6], F Cx[nw,6]) with the excitation scaled onto the spectral-
+    amplitude basis (zeta = sqrt(S)) used by the Morison path — the
+    BASELINE.json "precomputed on host and staged as device arrays" step.
+    """
+    from raft_tpu.core.cplx import Cx
+
+    A_bem, B_bem, F_bem = bem
+    A = jnp.asarray(np.moveaxis(np.asarray(A_bem), -1, 0))
+    B = jnp.asarray(np.moveaxis(np.asarray(B_bem), -1, 0))
+    Fb = np.moveaxis(np.asarray(F_bem), -1, 0)          # (nw,6) complex, host
+    zeta = np.asarray(wave.zeta)[:, None]
+    F = Cx(jnp.asarray(zeta * Fb.real), jnp.asarray(zeta * Fb.imag))
+    return A, B, F
+
+
 def forward_response(
     members: MemberSet,
     rna: RNA,
     env: Env,
     wave: WaveState,
     C_moor: Array,
-    n_iter: int = 15,
+    bem=None,
+    n_iter: int = 25,
     method: str = "scan",
 ):
     """Design -> RAO solve: the pure forward pipeline (statics through Xi).
 
-    Strip-theory path (BEM coefficients, if any, can be folded into C/M/B by
-    the caller).  Returns the :class:`~raft_tpu.solve.RAOResult`.
+    Strip-theory path by default; pass ``bem`` (the output of
+    :func:`stage_bem`) to add potential-flow coefficients — the potMod
+    members are then gated out of the Morison added mass/excitation exactly
+    as in ``Model._linear_coeffs`` so nothing double-counts.  ``n_iter``
+    covers the slowest-converging stock design (the OC4 semi needs ~22
+    iterations) with margin; ``method="while"`` early-exits on convergence,
+    while ``method="scan"`` (the reverse-differentiable driver) always runs
+    ``n_iter`` steps with post-convergence freezing — so keep the cap tight
+    for gradient work.
+    Returns the :class:`~raft_tpu.solve.RAOResult`.
     """
+    exclude = bem is not None
     stat = assemble_statics(members, rna, env)
     kin = node_kinematics(members, wave, env)
-    A = strip_added_mass(members, env)
-    F = strip_excitation(members, kin, env)
+    A = strip_added_mass(members, env, exclude_potmod=exclude)
+    F = strip_excitation(members, kin, env, exclude_potmod=exclude)
     nw = wave.w.shape[0]
+    M = jnp.broadcast_to(stat.M_struc + A, (nw, 6, 6))
+    B = jnp.zeros((nw, 6, 6), dtype=A.dtype)
+    if bem is not None:
+        A_bem, B_bem, F_bem = bem
+        M = M + A_bem
+        B = B + B_bem
+        F = F + F_bem
     lin = LinearCoeffs(
-        M=jnp.broadcast_to(stat.M_struc + A, (nw, 6, 6)),
-        B=jnp.zeros((nw, 6, 6), dtype=A.dtype),
+        M=M,
+        B=B,
         C=stat.C_struc + stat.C_hydro + C_moor,
         F=F,
     )
@@ -95,7 +132,7 @@ def sweep(
     thetas: Array,
     apply_fn=scale_diameters,
     mesh: Mesh | None = None,
-    n_iter: int = 15,
+    n_iter: int = 25,
 ):
     """Evaluate a batch of design variants, sharded over the mesh.
 
@@ -132,7 +169,7 @@ def grad_response_std(
     theta: Array,
     dof: int = 0,
     apply_fn=scale_diameters,
-    n_iter: int = 15,
+    n_iter: int = 25,
 ):
     """d sigma_dof / d theta — exact co-design gradient through the whole
     pipeline (statics, Morison, drag-linearized fixed point)."""

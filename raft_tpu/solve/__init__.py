@@ -5,4 +5,9 @@ from raft_tpu.solve.dynamics import (  # noqa: F401
     impedance,
     solve_dynamics,
 )
-from raft_tpu.solve.eigen import EigenResult, dominance_order, solve_eigen  # noqa: F401
+from raft_tpu.solve.eigen import (  # noqa: F401
+    EigenResult,
+    diagonal_estimates,
+    dominance_order,
+    solve_eigen,
+)

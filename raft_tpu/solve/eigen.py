@@ -53,6 +53,47 @@ def dominance_order(modes: Array) -> Array:
     return jnp.stack(picks, axis=-1)
 
 
+def diagonal_estimates(M_tot: Array, C_tot: Array) -> Array:
+    """Per-DOF natural-frequency estimates from the diagonal entries [Hz].
+
+    The reference's engineering cross-check on the full eigen solve
+    (raft/raft.py:1422-1446): translational DOFs and yaw use
+    ``sqrt(C_ii/M_ii)`` directly; roll and pitch are corrected to rotation
+    about the effective center of mass instead of the PRP, using the
+    off-diagonal coupling terms as levers —
+    ``z_CM = M[0,4]/M[0,0]`` (mass + added mass) and
+    ``z_moor = C[0,4]/C[0,0]`` (mooring reaction elevation) — rather than a
+    parallel-axis shift, because added mass moves the rotation point off the
+    CG.  Batched/vmappable; divisions are guarded for free DOFs.
+    """
+    M_tot = jnp.asarray(M_tot)
+    C_tot = jnp.asarray(C_tot)
+
+    def safe_div(a, b):
+        return jnp.where(jnp.abs(b) > 0, a / jnp.where(jnp.abs(b) > 0, b, 1.0), 0.0)
+
+    zMoorx = safe_div(C_tot[..., 0, 4], C_tot[..., 0, 0])
+    zMoory = safe_div(C_tot[..., 1, 3], C_tot[..., 1, 1])
+    zCMx = safe_div(M_tot[..., 0, 4], M_tot[..., 0, 0])
+    zCMy = safe_div(M_tot[..., 1, 3], M_tot[..., 1, 1])
+
+    def wn2(c, m):
+        return jnp.where(m > 0, jnp.clip(safe_div(c, m), 0.0, None), 0.0)
+
+    diagC = jnp.diagonal(C_tot, axis1=-2, axis2=-1)
+    diagM = jnp.diagonal(M_tot, axis1=-2, axis2=-1)
+    w2 = [wn2(diagC[..., i], diagM[..., i]) for i in range(6)]
+    # roll/pitch about the effective CM: stiffness gains the translational
+    # lever term, inertia loses the transfer term M_11 z_CM^2
+    c_roll = diagC[..., 3] + diagC[..., 1] * ((zCMy - zMoory) ** 2 - zMoory**2)
+    m_roll = diagM[..., 3] - diagM[..., 1] * zCMy**2
+    c_pitch = diagC[..., 4] + diagC[..., 0] * ((zCMx - zMoorx) ** 2 - zMoorx**2)
+    m_pitch = diagM[..., 4] - diagM[..., 0] * zCMx**2
+    w2[3] = wn2(c_roll, m_roll)
+    w2[4] = wn2(c_pitch, m_pitch)
+    return jnp.sqrt(jnp.stack(w2, axis=-1)) / _TWO_PI
+
+
 def solve_eigen(M_tot: Array, C_tot: Array, sweeps: int = 12) -> EigenResult:
     """Natural frequencies of the undamped 6-DOF system.
 

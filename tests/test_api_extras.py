@@ -5,6 +5,7 @@ import pytest
 from raft_tpu.model import Model, load_design
 
 
+@pytest.mark.slow
 def test_oc4_split_variant_matches_single_member():
     """OC4semi_2 (split-column decomposition) must reproduce OC4semi statics
     to machine precision — same platform, different member decomposition."""
@@ -21,6 +22,7 @@ def test_oc4_split_variant_matches_single_member():
     np.testing.assert_allclose(pa["C_stiffness"], pb["C_stiffness"], rtol=1e-9, atol=1e-3)
 
 
+@pytest.mark.slow
 def test_cli_json(capsys):
     import json
 
@@ -57,6 +59,7 @@ def test_profiling_phases():
     assert "hydro-strip" in s
 
 
+@pytest.mark.slow
 def test_weis_adapter_end_to_end():
     from raft_tpu.io.weis import design_from_weis, member_from_arrays, mooring_from_arrays
 

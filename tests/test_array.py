@@ -1,0 +1,124 @@
+"""Multi-turbine array tests: stacked FOWTs reproduce single-turbine runs.
+
+The array system is block-diagonal (no hull-to-hull hydrodynamic coupling,
+matching the reference architecture at raft/raft.py:1292-1298 which never
+couples FOWTs either), so:
+
+* N co-located identical turbines must reproduce N copies of the single-
+  turbine response exactly (block-diagonality).
+* A turbine offset down-wave by d must respond with the same amplitude and
+  an extra phase lag exp(-i k d) (linearity + incident-wave phasing).
+* Mixed-design arrays (different pad dims, different mooring) must match
+  each design's own single-turbine eigenfrequencies.
+"""
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+from raft_tpu.array import ArrayModel
+from raft_tpu.model import Model, load_design
+
+OC3 = "raft_tpu/designs/OC3spar.yaml"
+OC4 = "raft_tpu/designs/OC4semi.yaml"
+
+W = np.arange(0.05, 3.0, 0.25)          # coarse grid keeps the test fast
+
+
+@pytest.fixture(scope="module")
+def single():
+    m = Model(load_design(OC3), w=W)
+    m.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)
+    m.calcSystemProps()
+    m.solveEigen()
+    m.calcMooringAndOffsets()
+    m.solveDynamics()
+    return m
+
+
+@pytest.fixture(scope="module")
+def pair():
+    a = Model(load_design(OC3), w=W, nTurbines=2)
+    assert isinstance(a, ArrayModel)
+    a.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)
+    a.calcSystemProps()
+    a.solveEigen()
+    a.calcMooringAndOffsets()
+    a.solveDynamics()
+    return a
+
+
+def test_model_constructor_routes_to_array(pair):
+    assert pair.nT == 2
+    assert pair.results["properties"]["nDOF"] == 12
+
+
+def test_array_eigen_matches_single(single, pair):
+    f1 = single.results["eigen"]["frequencies"]
+    fa = pair.results["eigen"]["frequencies"]
+    assert fa.shape == (2, 6)
+    np.testing.assert_allclose(fa[0], f1, rtol=1e-8)
+    np.testing.assert_allclose(fa[1], f1, rtol=1e-8)
+
+
+def test_array_offsets_match_single(single, pair):
+    r1 = single.results["means"]["platform offset"]
+    ra = pair.results["means"]["platform offset"]
+    np.testing.assert_allclose(ra[0], r1, rtol=1e-6, atol=1e-9)
+    np.testing.assert_allclose(ra[1], r1, rtol=1e-6, atol=1e-9)
+
+
+def test_array_response_block_diagonal(single, pair):
+    """Co-located identical turbines = two copies of the single response."""
+    Xi1 = single.results["response"]["Xi"]                # (nw, 6)
+    Xa = pair.results["response"]["Xi per turbine"]       # (2, nw, 6)
+    assert pair.results["response"]["converged"].all()
+    np.testing.assert_allclose(Xa[0], Xi1, rtol=1e-6, atol=1e-10)
+    np.testing.assert_allclose(Xa[1], Xi1, rtol=1e-6, atol=1e-10)
+    # stacked 6N layout interleaves turbines on the DOF axis
+    flat = pair.results["response"]["Xi"]                 # (nw, 12)
+    np.testing.assert_allclose(flat[:, :6], Xi1, rtol=1e-6, atol=1e-10)
+    np.testing.assert_allclose(flat[:, 6:], Xi1, rtol=1e-6, atol=1e-10)
+
+
+def test_array_downwave_phase_lag(single):
+    """Turbine at (d, 0) in beta=0 waves: same |Xi|, phase lag k*d."""
+    d = 800.0
+    a = ArrayModel(load_design(OC3), positions=[[0.0, 0.0], [d, 0.0]], w=W)
+    a.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)
+    a.calcSystemProps()
+    a.calcMooringAndOffsets()
+    a.solveDynamics(tol=1e-4)
+    Xa = a.results["response"]["Xi per turbine"]
+    k = np.asarray(a.wave.k)
+    expect = Xa[0] * np.exp(-1j * k[:, None] * d)
+    # same drag linearization fixed point => exact phase relation
+    np.testing.assert_allclose(Xa[1], expect, rtol=2e-3, atol=1e-8)
+    np.testing.assert_allclose(np.abs(Xa[1]), np.abs(Xa[0]), rtol=2e-3, atol=1e-8)
+
+
+def test_mixed_design_array_eigen():
+    """OC3 + OC4 in one array: each block matches its own single model."""
+    d3, d4 = load_design(OC3), load_design(OC4)
+    a = ArrayModel([d3, d4], w=W)
+    a.setEnv(Hs=8.0, Tp=12.0)
+    a.calcSystemProps()
+    a.solveEigen()
+    fa = a.results["eigen"]["frequencies"]
+
+    for i, d in enumerate((d3, d4)):
+        m = Model(d, w=W)
+        m.setEnv(Hs=8.0, Tp=12.0)
+        m.calcSystemProps()
+        m.solveEigen()
+        np.testing.assert_allclose(
+            fa[i], m.results["eigen"]["frequencies"], rtol=1e-6
+        )
+
+
+def test_array_outputs_nacelle_accel(pair):
+    out = pair.calcOutputs()
+    a_nac = out["response"]["nacelle acceleration"]
+    assert a_nac.shape == (2, len(W))
+    assert np.isfinite(a_nac).all()
+    np.testing.assert_allclose(a_nac[0], a_nac[1], rtol=1e-6, atol=1e-12)

@@ -87,6 +87,7 @@ def test_cap_hole_pair_conventions():
         _cap_hole_pairs(np.array([1.0, 2.0, 3.0]), 2, circ=True)
 
 
+@pytest.mark.slow
 def test_waterline_station_no_double_count():
     """A station exactly at z=0 must not double-count waterplane terms."""
     import jax

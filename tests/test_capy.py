@@ -124,6 +124,7 @@ def test_reference_golden_interp_1e12():
 
 
 @needs_ref
+@pytest.mark.slow
 def test_capy_coeffs_feed_model():
     """End-to-end: capytaine dataset -> Model(BEM=...) solve."""
     from raft_tpu.model import Model, load_design

@@ -47,6 +47,7 @@ def test_oc3_natural_frequencies(model):
     assert 0.08 < fns[5] < 0.16         # yaw ~0.12 Hz
 
 
+@pytest.mark.slow
 def test_oc3_mean_offsets(model):
     model.calcMooringAndOffsets()
     r6 = model.results["means"]["platform offset"]
@@ -57,6 +58,7 @@ def test_oc3_mean_offsets(model):
     assert 0.01 < r6[4] < 0.15
 
 
+@pytest.mark.slow
 def test_oc3_rao_solve(model):
     model.calcMooringAndOffsets()
     model.solveDynamics()
@@ -81,6 +83,7 @@ def test_oc3_rao_solve(model):
     assert sigma[2] < 1.0
 
 
+@pytest.mark.slow
 def test_fairlead_tension_outputs(model):
     model.calcMooringAndOffsets()
     model.solveDynamics()
@@ -97,6 +100,7 @@ def test_fairlead_tension_outputs(model):
     assert np.isfinite(rao).all()
 
 
+@pytest.mark.slow
 def test_outputs_nacelle_accel(model):
     model.calcMooringAndOffsets()
     model.solveDynamics()
@@ -128,6 +132,7 @@ def test_bem_excitation_basis_consistency():
     np.testing.assert_allclose(dF_bem, zeta[:, None] * np.ones(6), rtol=1e-10)
 
 
+@pytest.mark.slow
 def test_bem_response_scales_with_hs():
     """With a pure-BEM excitation and no Morison drag on potMod members,
     response amplitude at each frequency scales ~linearly with Hs (the
@@ -155,6 +160,7 @@ def test_bem_response_scales_with_hs():
     assert (ratio > 1.5).all() and (ratio < 2.5).all()
 
 
+@pytest.mark.slow
 def test_run_raft_end_to_end():
     results = run_raft(DESIGN)
     assert set(results) >= {"properties", "means", "eigen", "response"}
@@ -231,6 +237,7 @@ def test_volturn_natural_periods(volturn):
     assert 75.0 < T[5] < 105.0          # yaw
 
 
+@pytest.mark.slow
 def test_volturn_dynamics(volturn):
     volturn.calcMooringAndOffsets()
     volturn.solveDynamics()
@@ -239,6 +246,7 @@ def test_volturn_dynamics(volturn):
     assert np.isfinite(resp["RAO magnitude"]).all()
 
 
+@pytest.mark.slow
 def test_oc4_dynamics(oc4):
     oc4.calcMooringAndOffsets()
     oc4.solveDynamics()

@@ -10,6 +10,7 @@ Oracles:
     3-line geometry force balance;
   * finite-difference check of the autodiff stiffness.
 """
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -119,6 +120,7 @@ def test_oc3_surge_stiffness_matches_published():
     )
 
 
+@pytest.mark.slow
 def test_stiffness_matches_finite_difference():
     sys = oc3_system()
     r6 = jnp.array([5.0, 1.0, -0.5, 0.01, 0.02, 0.005])
@@ -134,6 +136,7 @@ def test_stiffness_matches_finite_difference():
     np.testing.assert_allclose(C, C_fd, rtol=5e-3, atol=20.0)
 
 
+@pytest.mark.slow
 def test_equilibrium_under_thrust():
     sys = oc3_system()
     # body restoring: plausible OC3 hydrostatic + gravity stiffness
@@ -151,6 +154,7 @@ def test_equilibrium_under_thrust():
     assert abs(float(r6[1])) < 1.0
 
 
+@pytest.mark.slow
 def test_equilibrium_gradient_flows():
     sys = oc3_system()
     C_body = jnp.diag(jnp.array([0.0, 0.0, 3.3e5, 1.3e9, 1.3e9, 0.0]))
@@ -166,3 +170,50 @@ def test_equilibrium_gradient_flows():
     h = 1e2
     fd = (surge_offset(800e3 + h) - surge_offset(800e3 - h)) / (2 * h)
     np.testing.assert_allclose(float(g), float(fd), rtol=1e-3)
+
+
+def test_catenary_seabed_friction_roundtrip():
+    """Forward-generate (xf, zf) from known (H, V) with CB > 0 via the
+    closed-form profile, then check solve_catenary recovers (H, V) — and
+    that friction reduces the anchor tension by CB*w*LB."""
+    from raft_tpu.mooring.catenary import _profile_residual
+
+    p = LineProps(
+        L=jnp.asarray(900.0), w=jnp.asarray(1000.0), EA=jnp.asarray(1e9),
+        CB=jnp.asarray(1.0),
+    )
+    H0, V0 = jnp.asarray(2.0e5), jnp.asarray(5.0e5)     # touchdown: V < w L
+    rx, rz = _profile_residual(H0, V0, 0.0, 0.0, p)     # residual at (0,0)
+    xf, zf = rx, rz                                      # = closed-form spans
+    st = solve_catenary(xf, zf, p)
+    assert float(st.residual) < 1e-6
+    np.testing.assert_allclose(float(st.H), 2.0e5, rtol=1e-8)
+    np.testing.assert_allclose(float(st.V), 5.0e5, rtol=1e-8)
+    LB = 900.0 - 5.0e5 / 1000.0
+    np.testing.assert_allclose(
+        float(st.Ta), max(2.0e5 - 1.0 * 1000.0 * LB, 0.0), rtol=1e-8
+    )
+    # same spans with CB=0: friction reduces the grounded-portion stretch,
+    # so the frictional line needs (slightly) more H to span the same xf
+    st0 = solve_catenary(xf, zf, LineProps(L=p.L, w=p.w, EA=p.EA))
+    assert float(st0.residual) < 1e-6
+    assert float(st.H) > float(st0.H)
+
+
+def test_catenary_friction_slack_anchor():
+    """CB large enough that tension hits zero before the anchor: anchor
+    tension is exactly zero and the solve still converges."""
+    p = LineProps(
+        L=jnp.asarray(900.0), w=jnp.asarray(1000.0), EA=jnp.asarray(1e9),
+        CB=jnp.asarray(2.0),
+    )
+    from raft_tpu.mooring.catenary import _profile_residual
+
+    H0, V0 = jnp.asarray(1.0e5), jnp.asarray(4.0e5)
+    LB = 900.0 - 4.0e5 / 1000.0                          # 500 m grounded
+    assert 1.0e5 - 2.0 * 1000.0 * LB < 0                 # slack before anchor
+    rx, rz = _profile_residual(H0, V0, 0.0, 0.0, p)
+    st = solve_catenary(rx, rz, p)
+    assert float(st.residual) < 1e-6
+    np.testing.assert_allclose(float(st.H), 1.0e5, rtol=1e-7)
+    assert float(st.Ta) == 0.0

@@ -55,6 +55,7 @@ def test_deep_sphere_added_mass():
     assert A[0, 4, 0] == pytest.approx(A[4, 0, 0], abs=0.02 * rhoV)
 
 
+@pytest.mark.slow
 def test_model_with_native_bem_runs():
     from raft_tpu.model import Model, load_design
 

@@ -1,4 +1,5 @@
 """Sweep/sharding tests on the virtual 8-device CPU mesh (conftest)."""
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -32,6 +33,7 @@ def setup(nw=10):
     return members, rna, env, wave, C_moor
 
 
+@pytest.mark.slow
 def test_sweep_sharded_matches_single():
     members, rna, env, wave, C_moor = setup()
     assert len(jax.devices()) == 8
@@ -48,6 +50,7 @@ def test_sweep_sharded_matches_single():
     np.testing.assert_allclose(out["std dev"][5], np.asarray(sigma5), rtol=2e-5)
 
 
+@pytest.mark.slow
 def test_sweep_monotone_in_scale():
     # bigger platform -> different response; just check variation is real
     members, rna, env, wave, C_moor = setup()
@@ -57,6 +60,7 @@ def test_sweep_monotone_in_scale():
     assert len(set(np.round(surge, 6))) == 3
 
 
+@pytest.mark.slow
 def test_grad_response_matches_fd():
     members, rna, env, wave, C_moor = setup()
     g = grad_response_std(members, rna, env, wave, C_moor, jnp.asarray(1.0))

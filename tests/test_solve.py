@@ -8,6 +8,7 @@ Oracles:
     black box, validating the iteration driver itself;
   * numpy.linalg.eig for the eigen solve.
 """
+import pytest
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -75,6 +76,7 @@ def setup(nw=24, Cd=0.8, CdEnd=0.6, Hs=6.0):
     return m, kin, wave, env, lin
 
 
+@pytest.mark.slow
 def test_no_drag_matches_direct_solve():
     m, kin, wave, env, lin = setup(Cd=0.0, CdEnd=0.0)
     out = solve_dynamics(m, kin, wave, env, lin)
@@ -130,6 +132,7 @@ def test_while_matches_scan():
     assert int(a.n_iter) == int(b.n_iter)
 
 
+@pytest.mark.slow
 def test_vmap_over_seastates_matches_loop():
     m, kin, wave, env, lin = setup()
 
@@ -153,6 +156,7 @@ def test_vmap_over_seastates_matches_loop():
         )
 
 
+@pytest.mark.slow
 def test_grad_flows_through_scan():
     m, kin, wave, env, lin = setup()
 
@@ -171,6 +175,7 @@ def test_grad_flows_through_scan():
     np.testing.assert_allclose(float(g), float(fd), rtol=1e-4)
 
 
+@pytest.mark.slow
 def test_grad_finite_with_padded_nodes():
     # padded nodes have zero unit vectors -> vRMS hits sqrt(0); the
     # double-where in linearized_drag must keep the gradient finite
@@ -223,6 +228,7 @@ def test_eigen_dominance_order_diagonal():
     np.testing.assert_allclose(np.abs(np.asarray(out.modes)), np.eye(6), atol=1e-8)
 
 
+@pytest.mark.slow
 def test_eigen_batched():
     rng = np.random.default_rng(1)
     Ms, Cs = [], []
@@ -236,3 +242,76 @@ def test_eigen_batched():
     for i in range(3):
         lam_ref = np.sort(np.linalg.eigvals(np.linalg.inv(Ms[i]) @ Cs[i]).real)
         np.testing.assert_allclose(np.sort(np.asarray(out.wns[i]) ** 2), lam_ref, rtol=1e-7)
+
+
+def test_diagonal_estimates_decoupled():
+    """No off-diagonal coupling: every DOF estimate is sqrt(C_ii/M_ii)/2pi."""
+    from raft_tpu.solve import diagonal_estimates
+
+    m = np.array([1e6, 1e6, 1e6, 1e9, 1e9, 2e9])
+    c = np.array([4e4, 4e4, 3e5, 5e8, 5e8, 1e8])
+    est = np.asarray(diagonal_estimates(jnp.diag(jnp.asarray(m)), jnp.diag(jnp.asarray(c))))
+    np.testing.assert_allclose(est, np.sqrt(c / m) / (2 * np.pi), rtol=1e-10)
+
+
+def test_diagonal_estimates_cg_lever_matches_eigen():
+    """Surge-pitch coupled point mass: the z-lever-corrected pitch estimate
+    must agree with the full 2-DOF eigen solve (which the plain diagonal
+    entry C44/M44 does not)."""
+    from raft_tpu.solve import diagonal_estimates
+
+    m0, z0 = 5e6, -30.0          # mass at z0 below the PRP
+    I_cg = 2e9
+    C00, C44 = 1e5, 3e9          # mooring surge + hydrostatic pitch stiffness
+    M = np.zeros((6, 6))
+    M[0, 0] = M[1, 1] = M[2, 2] = m0
+    M[0, 4] = M[4, 0] = m0 * z0
+    M[1, 3] = M[3, 1] = m0 * z0
+    M[3, 3] = M[4, 4] = I_cg + m0 * z0 * z0
+    M[5, 5] = I_cg
+    C = np.diag([C00, C00, 3e5, C44, C44, 1e8]).astype(float)
+    est = np.asarray(diagonal_estimates(jnp.asarray(M), jnp.asarray(C)))
+    # full coupled surge-pitch eigenvalues
+    lam = np.linalg.eigvals(np.linalg.solve(M[np.ix_([0, 4], [0, 4])],
+                                            C[np.ix_([0, 4], [0, 4])]))
+    f_full = np.sqrt(np.sort(lam.real)) / (2 * np.pi)
+    assert abs(est[4] - f_full[1]) / f_full[1] < 0.02
+    # the naive diagonal entry is off by the z-lever correction
+    f_naive = np.sqrt(C44 / M[4, 4]) / (2 * np.pi)
+    assert abs(f_naive - f_full[1]) / f_full[1] > abs(est[4] - f_full[1]) / f_full[1]
+
+
+def test_eigen_bem_added_mass_fixed_point():
+    """With a strongly frequency-dependent staged A_bem, solveEigen must
+    evaluate A at each mode's own natural frequency (self-consistency),
+    not at the lowest grid frequency."""
+    from raft_tpu.model import Model, load_design
+    from raft_tpu.solve import solve_eigen as _se
+
+    design = load_design("raft_tpu/designs/OC3spar.yaml")
+    nw = 40
+    w = np.linspace(0.05, 2.0, nw)
+    # added mass decaying strongly in frequency: A(w) = A0 / (1 + 4 w^2)
+    A0 = 8e6
+    A = np.zeros((6, 6, nw))
+    for i in range(6):
+        A[i, i] = A0 / (1.0 + 4.0 * w**2) * (1e3 if i >= 3 else 1.0)
+    B0 = np.zeros((6, 6, nw))
+    F0 = np.zeros((6, nw), dtype=complex)
+    m = Model(design, w=w, BEM=(A, B0, F0))
+    m.setEnv(Hs=8.0, Tp=12.0)
+    m.calcSystemProps()
+    m.solveEigen()
+    fns = m.results["eigen"]["frequencies"]
+    assert np.isfinite(fns).all() and (fns > 0).all()
+    assert "estimates" in m.results["eigen"]
+    # self-consistency: re-assemble with A(wn_i) and re-solve; mode i's
+    # frequency must reproduce itself
+    M_base = np.asarray(m.statics.M_struc + m.A_morison)
+    C_tot = np.asarray(m.statics.C_struc + m.statics.C_hydro + m.C_moor0)
+    for i in (0, 2, 4):
+        wn = 2 * np.pi * fns[i]
+        Ai = np.stack([[np.interp(wn, w, A[a, b]) for b in range(6)]
+                       for a in range(6)])
+        out = _se(jnp.asarray(M_base + Ai), jnp.asarray(C_tot))
+        assert abs(np.asarray(out.wns)[i] - wn) / wn < 1e-3

@@ -42,6 +42,80 @@ def _cap_rings(r_outer: float, da_max: float):
     return np.linspace(r_outer, 0.0, n + 1)
 
 
+def _naz_levels(radii, da_max: float, naz_min: int = 4, naz_max: int = 512):
+    """Adaptive azimuthal sector counts, one per ring radius.
+
+    The capability of the reference mesher's azimuthal doubling/halving
+    (raft/member2pnl.py:177-242), designed as a per-member power-of-two
+    family: every ring gets the smallest count ``base * 2^k`` satisfying
+    the arc-length bound ``2 pi r / naz <= da_max``, with ``base`` chosen
+    from {4..7} to minimize the member's total sector count.  Adjacent
+    rings then differ by exactly 1:2 (or equal), so bands stitch with
+    watertight transition triangles — and large end caps coarsen toward
+    the axis instead of inheriting the outer ring's count.
+    """
+    radii = np.asarray(radii, dtype=float)
+    targets = 2.0 * np.pi * np.clip(radii, 0.0, None) / da_max
+
+    def level(base, t):
+        n = base
+        while n < t and n < naz_max:
+            n *= 2
+        return n
+
+    best, best_cost = None, None
+    for base in (4, 5, 6, 7):
+        ns = np.array([level(base, max(t, naz_min)) for t in targets])
+        cost = ns.sum()
+        if best_cost is None or cost < best_cost:
+            best, best_cost = ns, cost
+    # clamp jumps to one level between consecutive rings so every band is
+    # either conforming (1:1) or a single 1:2 transition
+    ns = best.astype(int)
+    for i in range(1, len(ns)):
+        ns[i] = min(ns[i], ns[i - 1] * 2)
+    for i in range(len(ns) - 2, -1, -1):
+        ns[i] = min(ns[i], ns[i + 1] * 2)
+    return ns
+
+
+def _band_panels(ring_a, ring_b):
+    """Panels between two rings with naz_a, naz_b in {equal, 1:2, 2:1}.
+
+    Vertex order (a_j, a_j+1, b_j+1, b_j) — the same cyclic sense as a
+    conforming quad strip — so outward orientation is preserved; 1:2
+    transitions emit three triangles per coarse sector (stored as
+    degenerate quads), keeping the surface watertight.
+    """
+    na, nb = len(ring_a) - 1, len(ring_b) - 1
+    out = []
+    if na == nb:
+        a0, a1 = ring_a[:-1], ring_a[1:]
+        b0, b1 = ring_b[:-1], ring_b[1:]
+        out.append(np.stack([a0, a1, b1, b0], axis=1))
+    elif nb == 2 * na:
+        for j in range(na):
+            aj, aj1 = ring_a[j], ring_a[j + 1]
+            f0, f1, f2 = ring_b[2 * j], ring_b[2 * j + 1], ring_b[2 * j + 2]
+            out.append(np.stack([
+                np.stack([aj, aj1, f1, f1]),
+                np.stack([aj, f1, f0, f0]),
+                np.stack([aj1, f2, f1, f1]),
+            ]))
+    elif na == 2 * nb:
+        for j in range(nb):
+            bj, bj1 = ring_b[j], ring_b[j + 1]
+            c0, c1, c2 = ring_a[2 * j], ring_a[2 * j + 1], ring_a[2 * j + 2]
+            out.append(np.stack([
+                np.stack([c0, c1, bj, bj]),
+                np.stack([c1, bj1, bj, bj]),
+                np.stack([c1, c2, bj1, bj1]),
+            ]))
+    else:
+        raise ValueError(f"non-stitchable ring counts {na}:{nb}")
+    return out
+
+
 def mesh_member(
     stations,
     diameters,
@@ -56,8 +130,10 @@ def mesh_member(
 
     ``stations`` are along-axis positions (member frame, 0 at end A),
     ``diameters`` the matching outer diameters; ``rA``/``rB`` the global end
-    positions.  Sides are revolved quads; flat end caps are ring/triangle
-    fans (cf. the reference's radial end fill, raft/member2pnl.py:149-165).
+    positions.  Sides are revolved bands with adaptive azimuthal counts
+    (see :func:`_naz_levels`); flat end caps are ring fans coarsening
+    toward the axis (cf. the reference's radial end fill + azimuthal
+    refinement, raft/member2pnl.py:149-242).
     """
     stations = np.asarray(stations, dtype=float)
     diameters = np.asarray(diameters, dtype=float)
@@ -65,43 +141,44 @@ def mesh_member(
     rB = np.asarray(rB, dtype=float)
 
     zs, rs = _profile(stations, 0.5 * diameters, dz_max)
-    r_max = rs.max()
-    naz = max(8, int(np.ceil(2.0 * np.pi * r_max / da_max)))
-    th = np.linspace(0.0, 2.0 * np.pi, naz + 1)
-    cos, sin = np.cos(th), np.sin(th)
 
-    panels = []
-
-    def ring(r, z):
-        return np.stack([r * cos, r * sin, np.full(naz + 1, z)], axis=-1)  # (naz+1,3)
-
-    def band(ringA, ringB, flip=False):
-        """Quads between two rings; vertex order sets the normal."""
-        a0, a1 = ringA[:-1], ringA[1:]
-        b0, b1 = ringB[:-1], ringB[1:]
-        quad = np.stack([a0, a1, b1, b0], axis=1)          # (naz,4,3)
-        if flip:
-            quad = quad[:, ::-1, :]
-        panels.append(quad)
-
-    # sides: outward normal for increasing z profile (A low, B high in local
-    # frame; the pose rotation below handles the rest)
-    for i in range(len(zs) - 1):
-        if zs[i + 1] <= zs[i] and rs[i + 1] == rs[i]:
-            continue
-        rA_ring = ring(rs[i], zs[i])
-        rB_ring = ring(rs[i + 1], zs[i + 1])
-        band(rA_ring, rB_ring, flip=False)
-
-    # end caps: A faces -z (local), B faces +z
+    # assemble the full ring sequence: cap A (axis -> rim), sides, cap B
+    # (rim -> axis), so adaptive counts are consistent across the seams
+    ring_r, ring_z, seg_kind = [], [], []
     if endA and rs[0] > 0:
-        rr = _cap_rings(rs[0], da_max)
-        for i in range(len(rr) - 1):
-            band(ring(rr[i + 1], zs[0]), ring(rr[i], zs[0]), flip=False)
+        rrA = _cap_rings(rs[0], da_max)[::-1]          # axis ... rim
+        ring_r.extend(rrA[:-1])
+        ring_z.extend([zs[0]] * (len(rrA) - 1))
+    n_capA = len(ring_r)
+    ring_r.extend(rs)
+    ring_z.extend(zs)
+    n_side_end = len(ring_r)
     if endB and rs[-1] > 0:
-        rr = _cap_rings(rs[-1], da_max)
-        for i in range(len(rr) - 1):
-            band(ring(rr[i], zs[-1]), ring(rr[i + 1], zs[-1]), flip=False)
+        rrB = _cap_rings(rs[-1], da_max)
+        ring_r.extend(rrB[1:])
+        ring_z.extend([zs[-1]] * (len(rrB) - 1))
+    ring_r = np.array(ring_r)
+    ring_z = np.array(ring_z)
+    naz = _naz_levels(ring_r, da_max)
+
+    def ring(i):
+        n = naz[i]
+        th = np.linspace(0.0, 2.0 * np.pi, n + 1)
+        return np.stack(
+            [ring_r[i] * np.cos(th), ring_r[i] * np.sin(th),
+             np.full(n + 1, ring_z[i])], axis=-1,
+        )
+
+    # orientation falls out of the ring ordering: lower-z ring (or the
+    # inner ring of a same-z annulus pair ordered inner->outer) in the
+    # first slot gives outward normals for sides, caps, and flange
+    # shoulders alike (cross-diagonal rule on [a_j, a_j+1, b_j+1, b_j])
+    panels = []
+    for i in range(len(ring_r) - 1):
+        same_z = abs(ring_z[i + 1] - ring_z[i]) < 1e-12
+        if same_z and ring_r[i + 1] == ring_r[i]:
+            continue
+        panels.extend(_band_panels(ring(i), ring(i + 1)))
 
     pans = np.concatenate(panels, axis=0)
 
@@ -161,13 +238,61 @@ def mesh_volume(panels: np.ndarray) -> float:
     return float((zc * n[:, 2] * a).sum())
 
 
-def mesh_design(design: dict, dz_max: float = 3.0, da_max: float = 2.0) -> np.ndarray:
+class _MemberSolid:
+    """Implicit solid of one circular member for interior-panel tests."""
+
+    def __init__(self, stations, radii, rA, rB):
+        self.rA = np.asarray(rA, dtype=float)
+        axis = np.asarray(rB, dtype=float) - self.rA
+        self.L = float(np.linalg.norm(axis))
+        self.q = axis / self.L
+        self.ts = np.asarray(stations, dtype=float)
+        self.rs = np.asarray(radii, dtype=float)
+
+    def contains(self, pts: np.ndarray, tol: float = 1e-3) -> np.ndarray:
+        """True for points inside or on the member surface (within tol)."""
+        rel = pts - self.rA
+        t = rel @ self.q
+        radial = np.linalg.norm(rel - t[:, None] * self.q[None, :], axis=-1)
+        r_at = np.interp(t, self.ts, self.rs)
+        return (t >= -tol) & (t <= self.L + tol) & (radial <= r_at + tol)
+
+
+def trim_interior_panels(panel_groups, solids, tol: float = 1e-3) -> np.ndarray:
+    """Drop panels lying inside (or on) ANOTHER member's solid.
+
+    Members meshed independently overlap where they join (e.g. an upper
+    column seated flush on a base column leaves two coincident interior
+    disks at the interface).  Such interior surfaces are not wetted hull;
+    left in, they pollute the radiation solve.  The reference mesher has no
+    equivalent (it meshes members independently and never trims,
+    raft/member2pnl.py:73-275) — interior trimming is required the moment
+    the BEM actually runs, which the reference never does.
+    """
+    kept = []
+    for gi, pans in enumerate(panel_groups):
+        if len(pans) == 0:
+            continue
+        cent = panel_centroids(pans)
+        interior = np.zeros(len(pans), dtype=bool)
+        for si, solid in enumerate(solids):
+            if si == gi:
+                continue
+            interior |= solid.contains(cent, tol=tol)
+        kept.append(pans[~interior])
+    if not kept:
+        return np.zeros((0, 4, 3))
+    return np.concatenate(kept, axis=0)
+
+
+def mesh_design(design: dict, dz_max: float = 3.0, da_max: float = 2.0,
+                trim: bool = True) -> np.ndarray:
     """Mesh every ``potMod`` circular member of a design dict
     (cf. FOWT.calcBEM, raft/raft.py:2016-2047).  Heading replication matches
-    the member builder."""
+    the member builder; panels interior to adjoining members are trimmed."""
     from raft_tpu.io.schema import get_from_dict
 
-    allp = []
+    groups, solids = [], []
     for mi in design["platform"]["members"]:
         if not mi.get("potMod", False):
             continue
@@ -186,12 +311,15 @@ def mesh_design(design: dict, dz_max: float = 3.0, da_max: float = 2.0) -> np.nd
                 c, s = np.cos(np.deg2rad(h)), np.sin(np.deg2rad(h))
                 rot = np.array([[c, s, 0.0], [-s, c, 0.0], [0.0, 0.0, 1.0]])
                 rA, rB = rot @ rA, rot @ rB
-            allp.append(
+            groups.append(
                 mesh_member(stations, d, rA, rB, dz_max=dz_max, da_max=da_max)
             )
-    if not allp:
+            solids.append(_MemberSolid(stations, 0.5 * d, rA, rB))
+    if not groups:
         return np.zeros((0, 4, 3))
-    return np.concatenate(allp, axis=0)
+    if trim:
+        return trim_interior_panels(groups, solids)
+    return np.concatenate(groups, axis=0)
 
 
 # ------------------------------------------------------------- file output

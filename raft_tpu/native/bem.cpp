@@ -324,6 +324,17 @@ void WaveTable::build() {
 }
 
 void WaveTable::eval(double X, double Y, double* i0, double* i1) const {
+    // near the origin the smooth parts still carry directional (X/Y-angle)
+    // structure the first bilinear cells cannot represent (errors up to
+    // ~0.3 absolute at rho ~ 0.02, which bias every distant panel pair at
+    // low frequency); evaluate exactly there instead.  Only nu*R, nu*|z+z'|
+    // both small lands here, so the extra quadrature cost is confined to
+    // the cheap low-frequency end of the sweep.
+    double rho0 = sqrt(X * X + Y * Y);
+    if (rho0 < 0.25 && rho0 > 1e-13) {
+        analytic_I(X, Y, i0, i1);
+        return;
+    }
     // beyond XMAX use the far-field asymptotics; beyond Y range the
     // integrand is dead (e^{uY} kills everything except the 1/r1-type part)
     if (X >= XMAX - 1e-9) {

@@ -190,17 +190,43 @@ def test_oc4_mass_properties(oc4):
 
 
 def test_oc4_natural_frequencies(oc4):
-    """Published OC4 Phase II system frequencies: surge ~0.0093 Hz,
-    heave ~0.0576 Hz, pitch ~0.0388 Hz, yaw ~0.0125 Hz."""
+    """Strip-theory-only OC4 periods, tightly pinned.
+
+    Strip theory overestimates surge added mass for the multi-column semi
+    (A11 ~1.01e7 kg vs ~8.5e6 potential flow — see DEVIATIONS.md), putting
+    the strip-path surge period at ~117 s; the BEM path (next test) lands
+    at ~115 s, matching the published simulation class.  The pins here are
+    +/-3% around the audited strip-theory values so a regression in any
+    statics/mooring/added-mass term trips them."""
     oc4.solveEigen()
     fns = oc4.results["eigen"]["frequencies"]
     # 120-degree symmetric mooring: surge and sway must be degenerate
     assert fns[0] == pytest.approx(fns[1], rel=1e-3)
-    assert 0.007 < fns[0] < 0.012      # surge
-    assert 0.048 < fns[2] < 0.068      # heave
-    assert 0.030 < fns[3] < 0.048      # roll
-    assert 0.030 < fns[4] < 0.048      # pitch
-    assert 0.008 < fns[5] < 0.018      # yaw
+    assert fns[0] == pytest.approx(0.00854, rel=0.03)   # surge: T ~117.1 s
+    assert fns[2] == pytest.approx(0.05749, rel=0.03)   # heave: T ~17.4 s
+    assert fns[3] == pytest.approx(0.03977, rel=0.04)   # roll
+    assert fns[4] == pytest.approx(0.03978, rel=0.04)   # pitch: T ~25.1 s
+    assert fns[5] == pytest.approx(0.01222, rel=0.04)   # yaw:   T ~81.8 s
+
+
+@pytest.mark.slow
+def test_oc4_bem_natural_periods():
+    """OC4 periods with the native BEM on the potMod columns, pinned to the
+    published values: surge/sway ~115 s (the OC4 Phase II simulation class;
+    the MARIN experiment's 107 s folds in dynamic-mooring effects outside
+    this quasi-static model class — audit in DEVIATIONS.md), heave 17.5 s,
+    pitch ~26 s, yaw ~80 s (Robertson et al., NREL/TP-5000-60601)."""
+    m = Model(load_design("raft_tpu/designs/OC4semi.yaml"), BEM="native",
+              w=np.linspace(0.05, 1.2, 8))
+    m.setEnv(Hs=6.0, Tp=10.0)
+    m.calcSystemProps()
+    m.solveEigen()
+    T = m.results["eigen"]["periods"]
+    assert T[0] == pytest.approx(115.9, rel=0.05)       # surge (pub. sim 115.9)
+    assert T[1] == pytest.approx(T[0], rel=1e-3)        # sway degenerate
+    assert T[2] == pytest.approx(17.5, rel=0.05)        # heave (pub. 17.5)
+    assert T[4] == pytest.approx(26.0, rel=0.08)        # pitch (pub. ~26.8)
+    assert T[5] == pytest.approx(80.2, rel=0.05)        # yaw   (pub. 80.2)
 
 
 # ------------------------------------------------------ VolturnUS-S

@@ -32,24 +32,36 @@ def read_wamit1(path: str):
     return w, A, B
 
 
-def read_wamit3(path: str):
-    """Read a WAMIT .3 excitation file.
+def read_wamit3(path: str, heading: float | None = None):
+    """Read a WAMIT .3 excitation file, ALL headings.
 
-    Returns (w, headings, mod[6,nw], phase_deg[6,nw], re[6,nw], im[6,nw])
-    for the first heading (multi-heading files: shape [nh*nw] rows ordered
-    by frequency-major, matching the reference's single-heading assumption).
+    Returns (w, headings, mod, phase_deg, re, im).  With one heading in the
+    file (or ``heading=`` selecting one) the arrays are [6, nw] — the
+    reference reader's layout (hams/pyhams.py:325-359, which always keeps a
+    single heading).  Multi-heading files return [nh, 6, nw] stacked in
+    ``headings`` order.
     """
     data = np.loadtxt(path)
     w = np.unique(data[:, 0])
     headings = np.unique(data[:, 1])
-    if len(headings) > 1:
-        data = data[np.isclose(data[:, 1], headings[0])]
-    nw = len(w)
-    mod = data[:, 3].reshape(nw, 6).T
-    phase = data[:, 4].reshape(nw, 6).T
-    re = data[:, 5].reshape(nw, 6).T
-    im = data[:, 6].reshape(nw, 6).T
-    return w, headings, mod, phase, re, im
+    if heading is not None:
+        i = int(np.argmin(np.abs(headings - heading)))
+        if not np.isclose(headings[i], heading):
+            raise ValueError(
+                f"heading {heading} not in file (has {headings})"
+            )
+        data = data[np.isclose(data[:, 1], headings[i])]
+        headings = headings[i : i + 1]
+    nw, nh = len(w), len(headings)
+
+    def grab(col):
+        out = np.empty((nh, 6, nw))
+        for ih, hd in enumerate(headings):
+            rows = data[np.isclose(data[:, 1], hd)]
+            out[ih] = rows[:, col].reshape(nw, 6).T
+        return out[0] if nh == 1 else out
+
+    return w, headings, grab(3), grab(4), grab(5), grab(6)
 
 
 def read_wamit_hst(path: str):
@@ -106,11 +118,16 @@ def interp_to_grid(w_src, arr, w_dst):
     return out
 
 
-def load_wamit_coeffs(path1: str, path3: str, w_grid, rho=1025.0, g=9.81):
+def load_wamit_coeffs(path1: str, path3: str, w_grid, rho=1025.0, g=9.81,
+                      heading: float | None = None):
     """Read + dimensionalize + interpolate: returns (A, B, F) on w_grid,
-    ready for ``Model(design, BEM=(A, B, F))``."""
+    ready for ``Model(design, BEM=(A, B, F))``.  Multi-heading .3 files:
+    pass ``heading`` (deg) to select one; default takes the first heading
+    (the reference reader's behavior, hams/pyhams.py:325-359)."""
     w1, A_bar, B_bar = read_wamit1(path1)
-    w3, _, _, _, re, im = read_wamit3(path3)
+    w3, hds, _, _, re, im = read_wamit3(path3, heading=heading)
+    if re.ndim == 3:                       # multi-heading, none selected
+        re, im = re[0], im[0]
     A, B, F = dimensionalize(w1, A_bar, B_bar, re, im, rho=rho, g=g)
     if len(w1) != len(w3) or not np.allclose(w1, w3):
         F = interp_to_grid(w3, F, w1)
@@ -150,18 +167,30 @@ def write_wamit1(path: str, w, A, B, rho=1025.0, g=9.81, ulen=1.0):
 
 
 def write_wamit3(path: str, w, F, rho=1025.0, g=9.81, ulen=1.0, heading=0.0):
-    """Write a WAMIT .3 excitation file from SI F[6,nw] (complex, per unit
-    wave amplitude)."""
-    _, _, X_bar = nondimensionalize(w, np.zeros((6, 6, len(w))),
-                                    np.ones((6, 6, len(w))), F,
-                                    rho=rho, g=g, ulen=ulen)
+    """Write a WAMIT .3 excitation file from SI excitation (complex, per
+    unit wave amplitude): F[6,nw] with a scalar ``heading`` [deg], or
+    F[nh,6,nw] with ``heading`` a matching grid of degrees."""
+    F = np.asarray(F)
+    if F.ndim == 2:
+        F = F[None]
+        headings = [float(heading)]
+    else:
+        headings = list(np.atleast_1d(heading).astype(float))
+        if len(headings) != F.shape[0]:
+            raise ValueError(f"{F.shape[0]} heading blocks, {len(headings)} headings")
+    X_bars = [
+        nondimensionalize(w, np.zeros((6, 6, len(w))), np.ones((6, 6, len(w))),
+                          F[ih], rho=rho, g=g, ulen=ulen)[2]
+        for ih in range(len(headings))
+    ]
     with open(path, "w") as f:
         for iw, wv in enumerate(np.asarray(w)):
-            for i in range(6):
-                x = X_bar[i, iw]
-                f.write(f" {wv:13.6E} {heading:10.3f} {i+1:5d} "
-                        f"{abs(x):13.6E} {np.degrees(np.angle(x)):13.6E} "
-                        f"{x.real:13.6E} {x.imag:13.6E}\n")
+            for ih, hd in enumerate(headings):
+                for i in range(6):
+                    x = X_bars[ih][i, iw]
+                    f.write(f" {wv:13.6E} {hd:10.3f} {i+1:5d} "
+                            f"{abs(x):13.6E} {np.degrees(np.angle(x)):13.6E} "
+                            f"{x.real:13.6E} {x.imag:13.6E}\n")
     return path
 
 

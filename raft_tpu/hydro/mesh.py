@@ -144,15 +144,13 @@ def mesh_member(
 
     # assemble the full ring sequence: cap A (axis -> rim), sides, cap B
     # (rim -> axis), so adaptive counts are consistent across the seams
-    ring_r, ring_z, seg_kind = [], [], []
+    ring_r, ring_z = [], []
     if endA and rs[0] > 0:
         rrA = _cap_rings(rs[0], da_max)[::-1]          # axis ... rim
         ring_r.extend(rrA[:-1])
         ring_z.extend([zs[0]] * (len(rrA) - 1))
-    n_capA = len(ring_r)
     ring_r.extend(rs)
     ring_z.extend(zs)
-    n_side_end = len(ring_r)
     if endB and rs[-1] > 0:
         rrB = _cap_rings(rs[-1], da_max)
         ring_r.extend(rrB[1:])
@@ -238,6 +236,78 @@ def mesh_volume(panels: np.ndarray) -> float:
     return float((zc * n[:, 2] * a).sum())
 
 
+
+def _iter_potmod_members(design: dict):
+    """Yield (stations, diameters, rA, rB) for every heading-replicated
+    potMod circular member — the shared selection/pose logic of
+    :func:`mesh_design` and :func:`mesh_lid`."""
+    from raft_tpu.io.schema import get_from_dict
+
+    for mi in design["platform"]["members"]:
+        if not mi.get("potMod", False):
+            continue
+        if str(mi["shape"])[0].lower() != "c":
+            continue                      # rect members stay on the Morison path
+        stations = np.asarray(mi["stations"], dtype=float)
+        stations = stations - stations[0]
+        d = np.asarray(mi["d"], dtype=float)
+        if d.ndim == 0:
+            d = np.full(len(stations), float(d))
+        headings = np.atleast_1d(get_from_dict(mi, "heading", shape=-1, default=0.0))
+        for h in headings:
+            rA = np.asarray(mi["rA"], dtype=float)
+            rB = np.asarray(mi["rB"], dtype=float)
+            if h != 0.0:
+                c, s = np.cos(np.deg2rad(h)), np.sin(np.deg2rad(h))
+                rot = np.array([[c, s, 0.0], [-s, c, 0.0], [0.0, 0.0, 1.0]])
+                rA, rB = rot @ rA, rot @ rB
+            yield stations, d, rA, rB
+
+
+def disk_panels(center, r_outer: float, da_max: float = 2.0, z: float = 0.0):
+    """Horizontal disk fan at height ``z`` (adaptive ring counts) — used for
+    interior waterplane lids in irregular-frequency removal."""
+    rr = _cap_rings(r_outer, da_max)[::-1]             # axis -> rim
+    naz = _naz_levels(rr, da_max)
+    cx, cy = float(center[0]), float(center[1])
+
+    def ring(i):
+        n = naz[i]
+        th = np.linspace(0.0, 2.0 * np.pi, n + 1)
+        return np.stack(
+            [cx + rr[i] * np.cos(th), cy + rr[i] * np.sin(th),
+             np.full(n + 1, z)], axis=-1,
+        )
+
+    panels = []
+    for i in range(len(rr) - 1):
+        if rr[i + 1] == rr[i]:
+            continue
+        panels.extend(_band_panels(ring(i), ring(i + 1)))
+    return np.concatenate(panels, axis=0)
+
+
+def mesh_lid(design: dict, da_max: float = 2.0) -> np.ndarray:
+    """Interior waterplane lid for every surface-piercing potMod circular
+    member: the extended-boundary-integral surface that removes irregular
+    frequencies from the native BEM solve (the reference's HAMS `irr`
+    option, hams/pyhams.py:200,284).  Returns (n,4,3) panels at z=0."""
+    lids = []
+    for stations, d, rA, rB in _iter_potmod_members(design):
+        if not (min(rA[2], rB[2]) < 0.0 <= max(rA[2], rB[2])):
+            continue                                 # not surface-piercing
+        t = (0.0 - rA[2]) / (rB[2] - rA[2])
+        L = np.linalg.norm(rB - rA)
+        r_wl = float(np.interp(t * L, stations, 0.5 * d))
+        if r_wl <= 0:
+            continue
+        center = rA + t * (rB - rA)
+        lids.append(disk_panels(center, r_wl, da_max=da_max))
+    if not lids:
+        return np.zeros((0, 4, 3))
+    return np.concatenate(lids, axis=0)
+
+
 class _MemberSolid:
     """Implicit solid of one circular member for interior-panel tests."""
 
@@ -290,31 +360,12 @@ def mesh_design(design: dict, dz_max: float = 3.0, da_max: float = 2.0,
     """Mesh every ``potMod`` circular member of a design dict
     (cf. FOWT.calcBEM, raft/raft.py:2016-2047).  Heading replication matches
     the member builder; panels interior to adjoining members are trimmed."""
-    from raft_tpu.io.schema import get_from_dict
-
     groups, solids = [], []
-    for mi in design["platform"]["members"]:
-        if not mi.get("potMod", False):
-            continue
-        if str(mi["shape"])[0].lower() != "c":
-            continue                      # rect members stay on the Morison path
-        stations = np.asarray(mi["stations"], dtype=float)
-        stations = stations - stations[0]
-        d = np.asarray(mi["d"], dtype=float)
-        if d.ndim == 0:
-            d = np.full(len(stations), float(d))
-        headings = np.atleast_1d(get_from_dict(mi, "heading", shape=-1, default=0.0))
-        for h in headings:
-            rA = np.asarray(mi["rA"], dtype=float)
-            rB = np.asarray(mi["rB"], dtype=float)
-            if h != 0.0:
-                c, s = np.cos(np.deg2rad(h)), np.sin(np.deg2rad(h))
-                rot = np.array([[c, s, 0.0], [-s, c, 0.0], [0.0, 0.0, 1.0]])
-                rA, rB = rot @ rA, rot @ rB
-            groups.append(
-                mesh_member(stations, d, rA, rB, dz_max=dz_max, da_max=da_max)
-            )
-            solids.append(_MemberSolid(stations, 0.5 * d, rA, rB))
+    for stations, d, rA, rB in _iter_potmod_members(design):
+        groups.append(
+            mesh_member(stations, d, rA, rB, dz_max=dz_max, da_max=da_max)
+        )
+        solids.append(_MemberSolid(stations, 0.5 * d, rA, rB))
     if not groups:
         return np.zeros((0, 4, 3))
     if trim:

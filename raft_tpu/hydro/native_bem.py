@@ -47,15 +47,17 @@ def _load():
     global _lib
     if _lib is None:
         lib = ctypes.CDLL(_build_lib())
-        lib.bem_solve.restype = ctypes.c_int
-        lib.bem_solve.argtypes = [
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int,      # panels, np
-            ctypes.POINTER(ctypes.c_double), ctypes.c_int,      # w, nw
+        dptr = ctypes.POINTER(ctypes.c_double)
+        lib.bem_solve_mh.restype = ctypes.c_int
+        lib.bem_solve_mh.argtypes = [
+            dptr, ctypes.c_int,                                 # panels, np
+            dptr, ctypes.c_int,                                 # w, nw
             ctypes.c_double,                                    # depth
-            ctypes.c_double, ctypes.c_double, ctypes.c_double,  # rho, g, beta
-            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
-            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
-            ctypes.c_int,
+            ctypes.c_double, ctypes.c_double,                   # rho, g
+            dptr, ctypes.c_int,                                 # betas, nb
+            dptr, dptr, dptr, dptr,                             # A, B, Fre, Fim
+            dptr, dptr,                                         # Fhre, Fhim (Haskind, may be NULL)
+            ctypes.c_int, ctypes.c_int,                         # nthreads, nlid
         ]
         lib.bem_green_fd.restype = None
         lib.bem_green_fd.argtypes = [ctypes.c_double] * 5 + [
@@ -108,20 +110,44 @@ def solve_bem(
     w: np.ndarray,
     rho: float = 1025.0,
     g: float = 9.81,
-    beta: float = 0.0,
+    beta=0.0,
     depth: float = 0.0,
     nthreads: int = 0,
     cache: bool = True,
+    haskind: bool = False,
+    lid: np.ndarray | None = None,
 ):
     """Run the native BEM solve (finite depth when ``depth`` > 0, else deep).
 
-    panels: (np, 4, 3) hull mesh (outward normals); w: (nw,) rad/s.
-    Returns (A[6,6,nw], B[6,6,nw], F[6,nw] complex), reference-layout arrays
-    matching the WAMIT readers so either provider can feed the Model.
+    panels: (np, 4, 3) hull mesh (outward normals); w: (nw,) rad/s;
+    ``beta``: one heading [rad] or a heading grid — the influence matrix is
+    factored once per frequency and each extra heading is one extra
+    back-substitution (the capability of the reference's HAMS heading grid,
+    hams/pyhams.py:196-289 num_headings/d_heading).
+
+    Returns (A[6,6,nw], B[6,6,nw], F) with F[6,nw] complex for a scalar
+    heading (reference WAMIT-reader layout) or F[nb,6,nw] for a grid.
+    With ``haskind=True`` returns (A, B, F, Fh) where Fh is the excitation
+    from the Haskind relation X_j = i w rho Int(phi_I n_j - phi_j
+    dphi_I/dn) dS — an independent check of F in amplitude and phase.
+
+    ``lid``: optional (nl, 4, 3) interior waterplane panels at z=0
+    (:func:`raft_tpu.hydro.mesh.mesh_lid`).  Activates the extended
+    boundary integral equation (zero interior potential on the lid),
+    removing the irregular frequencies of the plain source formulation —
+    the reference's HAMS `irr` capability (hams/pyhams.py:200,284).
     """
     panels = np.ascontiguousarray(panels, dtype=np.float64)
+    n_lid = 0
+    if lid is not None and len(lid) > 0:
+        panels = np.ascontiguousarray(
+            np.concatenate([panels, np.asarray(lid, dtype=np.float64)]), dtype=np.float64
+        )
+        n_lid = len(lid)
     w = np.ascontiguousarray(np.atleast_1d(w), dtype=np.float64)
-    n_p, n_w = len(panels), len(w)
+    scalar_beta = np.ndim(beta) == 0
+    betas = np.ascontiguousarray(np.atleast_1d(beta), dtype=np.float64)
+    n_p, n_w, n_b = len(panels), len(w), len(betas)
     depth = float(depth) if depth and depth > 0 else -1.0
 
     key = None
@@ -131,31 +157,49 @@ def solve_bem(
             h.update(f.read())                # solver edits invalidate cache
         h.update(panels.tobytes())
         h.update(w.tobytes())
-        h.update(np.array([rho, g, beta, depth]).tobytes())
+        h.update(betas.tobytes())
+        h.update(np.array([rho, g, depth, float(haskind), float(n_lid)]).tobytes())
         key = os.path.join(
             os.path.expanduser("~/.cache/raft_tpu/bem"), h.hexdigest()[:24] + ".npz"
         )
         if os.path.exists(key):
             z = np.load(key)
-            return z["A"], z["B"], z["F"]
+            out = (z["A"], z["B"], z["F"][0] if scalar_beta else z["F"])
+            if haskind:
+                return out + ((z["Fh"][0] if scalar_beta else z["Fh"]),)
+            return out
 
     lib = _load()
     A = np.zeros((n_w, 6, 6))
     B = np.zeros((n_w, 6, 6))
-    Fre = np.zeros((n_w, 6))
-    Fim = np.zeros((n_w, 6))
-    dptr = lambda a: a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
-    ret = lib.bem_solve(
-        dptr(panels), n_p, dptr(w), n_w, depth, rho, g, beta,
-        dptr(A), dptr(B), dptr(Fre), dptr(Fim), nthreads,
+    Fre = np.zeros((n_w, n_b, 6))
+    Fim = np.zeros((n_w, n_b, 6))
+    Fhre = np.zeros((n_w, n_b, 6)) if haskind else None
+    Fhim = np.zeros((n_w, n_b, 6)) if haskind else None
+    dptr = lambda a: (
+        a.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) if a is not None else None
+    )
+    ret = lib.bem_solve_mh(
+        dptr(panels), n_p, dptr(w), n_w, depth, rho, g,
+        dptr(betas), n_b,
+        dptr(A), dptr(B), dptr(Fre), dptr(Fim),
+        dptr(Fhre), dptr(Fhim), nthreads, n_lid,
     )
     if ret != 0:
         raise RuntimeError(f"bem_solve failed with code {ret}")
     A = A.transpose(1, 2, 0)
     B = B.transpose(1, 2, 0)
-    F = (Fre + 1j * Fim).T
+    # (nw, nb, 6) -> (nb, 6, nw)
+    F = (Fre + 1j * Fim).transpose(1, 2, 0)
+    Fh = (Fhre + 1j * Fhim).transpose(1, 2, 0) if haskind else None
 
     if cache and key is not None:
         os.makedirs(os.path.dirname(key), exist_ok=True)
-        np.savez_compressed(key, A=A, B=B, F=F)
-    return A, B, F
+        if haskind:
+            np.savez_compressed(key, A=A, B=B, F=F, Fh=Fh)
+        else:
+            np.savez_compressed(key, A=A, B=B, F=F)
+    if scalar_beta:
+        F = F[0]
+        Fh = Fh[0] if haskind else None
+    return (A, B, F, Fh) if haskind else (A, B, F)

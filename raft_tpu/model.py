@@ -129,14 +129,18 @@ class Model:
 
     # ------------------------------------------------------------- statics
 
-    def calcBEM(self, dz_max: float = 3.0, da_max: float = 2.0, out_dir: str | None = None):
+    def calcBEM(self, dz_max: float = 3.0, da_max: float = 2.0,
+                out_dir: str | None = None, irr: bool = False):
         """Mesh potMod members and run the native BEM solver
         (cf. FOWT.calcBEM, raft/raft.py:2016-2073 — where the reference
         leaves the solve commented out, this one runs).
 
-        Writes HullMesh.pnl / platform.gdf when ``out_dir`` is given,
-        matching the reference's on-disk artifacts."""
-        from raft_tpu.hydro.mesh import mesh_design, write_gdf, write_pnl
+        ``irr=True`` adds interior waterplane lid panels and the extended
+        boundary integral equation, removing irregular frequencies (the
+        HAMS `irr` knob, hams/pyhams.py:200,284).  Writes HullMesh.pnl /
+        platform.gdf when ``out_dir`` is given, matching the reference's
+        on-disk artifacts."""
+        from raft_tpu.hydro.mesh import mesh_design, mesh_lid, write_gdf, write_pnl
         from raft_tpu.hydro.native_bem import solve_bem
 
         with phase("calcBEM"):
@@ -149,12 +153,13 @@ class Model:
                 os.makedirs(out_dir, exist_ok=True)
                 write_pnl(os.path.join(out_dir, "HullMesh.pnl"), panels)
                 write_gdf(os.path.join(out_dir, "platform.gdf"), panels)
+            lid = mesh_lid(self.design, da_max=da_max) if irr else None
             # finite-depth Green function below k0*depth = 10 (native
             # solver switches per frequency); deep water beyond
             self.bem = solve_bem(
                 panels, np.asarray(self.w),
                 rho=float(self.env.rho), g=float(self.env.g),
-                beta=float(self.env.beta), depth=self.depth,
+                beta=float(self.env.beta), depth=self.depth, lid=lid,
             )
         return self.bem
 

@@ -709,23 +709,36 @@ static void assemble(const std::vector<Panel>& pan, double k,
             // image (1/r1): field point vs image panel (z -> -z of Q).
             // panels at the waterline nearly coincide with their own image,
             // so the subdivision must go much finer than for body pairs
-            double potI, gradI[3];
+            double potI, gradI[3] = {0, 0, 0};
             Panel qi = q;
             for (int vv = 0; vv < 4; vv++) qi.v[vv][2] = -q.v[vv][2];
             qi.c[2] = -q.c[2];
             {
                 double dzI = P[2] - qi.c[2];
                 double distI = sqrt(dx*dx + dy*dy + dzI*dzI);
-                double rel = distI / q.diag;
-                int ns = rel < 0.5 ? 24 : rel < 1.0 ? 12 : rel < 2.0 ? 6
-                       : rel < 6.0 ? 3 : 1;
-                rankine_integral(qi, P, ns, &potI, gradI);
+                if (i == j && distI < 1e-9 * q.diag) {
+                    // lid panel AT z=0: the image coincides with the panel
+                    // itself -- exact self potential, PV gradient 0
+                    potI = self_rankine_potential(qi);
+                } else {
+                    double rel = distI / q.diag;
+                    int ns = rel < 0.5 ? 24 : rel < 1.0 ? 12 : rel < 2.0 ? 6
+                           : rel < 6.0 ? 3 : 1;
+                    rankine_integral(qi, P, ns, &potI, gradI);
+                }
             }
             // wave part at centroids (smooth); finite depth adds the
-            // seabed image and evanescent-mode corrections
+            // seabed image and evanescent-mode corrections.  A lid panel's
+            // self term sits exactly at the R=0, z=z'=0 log singularity of
+            // the wave kernel: use the panel's log-average radius as the
+            // effective evaluation point (panel-mean of the ln term)
             cdouble Gw, gw[3];
+            double R_eff = sqrt(dx * dx + dy * dy);
+            if (i == j && R_eff < 1e-12 && fabs(P[2]) < 1e-9 * q.diag) {
+                R_eff = 0.4 * sqrt(q.area);
+            }
             if (fd && fd->active) {
-                double R = sqrt(dx * dx + dy * dy);
+                double R = R_eff;
                 cdouble G, dGdR, dGdz;
                 fd->eval(R, P[2], q.c[2], &G, &dGdR, &dGdz);
                 double ux = R > 1e-12 ? dx / R : 0.0;
@@ -735,7 +748,11 @@ static void assemble(const std::vector<Panel>& pan, double k,
                 gw[1] = dGdR * uy;
                 gw[2] = dGdz;
             } else {
-                wave_part(k, P, q.c, &Gw, gw);
+                double Pe[3] = { P[0], P[1], P[2] };
+                if (i == j && R_eff > 0 && sqrt(dx * dx + dy * dy) < 1e-12) {
+                    Pe[0] = q.c[0] + R_eff;   // lid self: log-average offset
+                }
+                wave_part(k, Pe, q.c, &Gw, gw);
             }
             cdouble S = pot + potI + Gw * q.area;
             cdouble Dn = (grad[0] + gradI[0] + gw[0] * q.area) * pan[i].n[0]
@@ -793,11 +810,21 @@ extern "C" {
 // panels: np x 4 x 3 (row-major); w: nw angular frequencies; depth <= 0
 // means infinite depth (deep water).  Outputs (row-major): A, Bo:
 // nw x 6 x 6; Fre, Fim: nw x 6.  Returns 0 on success.
-int bem_solve(const double* panels, int np,
-              const double* w, int nw, double depth,
-              double rho, double g, double beta,
-              double* A, double* Bo, double* Fre, double* Fim,
-              int nthreads) {
+static int solve_core(const double* panels, int np,
+                      const double* w, int nw, double depth,
+                      double rho, double g,
+                      const double* betas, int nb,
+                      double* A, double* Bo, double* Fre, double* Fim,
+                      double* Fhre, double* Fhim,
+                      int nthreads, int nlid) {
+    // nlid > 0: the LAST nlid panels are an interior waterplane lid.  The
+    // extended boundary integral equation forces the interior extension of
+    // the potential to vanish on the lid (sigma rows: S sigma = phi target,
+    // no jump term for the continuous single layer), which removes the
+    // irregular frequencies of the plain source formulation -- the
+    // capability behind the reference's HAMS `irr` flag
+    // (hams/pyhams.py:200,284), which its missing Fortran binary never
+    // actually exercised.
 #ifdef _OPENMP
     if (nthreads > 0) omp_set_num_threads(nthreads);
 #endif
@@ -810,6 +837,7 @@ int bem_solve(const double* panels, int np,
         panel_setup(pan[i]);
     }
     int n = np;
+    int nh = np - nlid;                           // hull panels (wetted)
     for (int iw = 0; iw < nw; iw++) {
         double om = w[iw];
         double k = om * om / g;                       // nu (deep wavenumber)
@@ -830,15 +858,22 @@ int bem_solve(const double* panels, int np,
         };
         Influence inf;
         assemble(pan, k, fd.active ? &fd : nullptr, inf);
-        // system: (-2 pi I + D) sigma = rhs, 7 RHS (6 radiation + diffraction)
+        // system: (-2 pi I + D) sigma = rhs, 6 + nb RHS (6 radiation + one
+        // diffraction column per heading -- the LU is factored once and
+        // every extra heading is just another back-substitution)
         // -- exterior limit with the collocation normal pointing INTO the
         // fluid gives the jump  d(phi)/dn -> -2 pi sigma + PV D sigma
         // (verified against the sphere single-layer harmonics: S Y_n =
         // 4 pi a/(2n+1) Y_n, D Y_n = -2 pi/(2n+1) Y_n).
         std::vector<cdouble> M = inf.D;
         for (int i = 0; i < n; i++) M[(size_t)i * n + i] += -2.0 * PI;
-        int m = 7;
+        // lid rows: Dirichlet condition on the interior free surface
+        for (int i = nh; i < n; i++)
+            for (int j = 0; j < n; j++)
+                M[(size_t)i * n + j] = inf.S[(size_t)i * n + j];
+        int m = 6 + nb;
         std::vector<cdouble> rhs((size_t)n * m);
+        std::vector<cdouble> dphiI_dn((size_t)n * nb);   // saved for Haskind
         for (int i = 0; i < n; i++) {
             const Panel& p = pan[i];
             double rx = p.c[0], ry = p.c[1], rz = p.c[2];
@@ -848,32 +883,46 @@ int bem_solve(const double* panels, int np,
                 rz * p.n[0] - rx * p.n[2],
                 rx * p.n[1] - ry * p.n[0],
             };
-            for (int kk = 0; kk < 6; kk++) rhs[(size_t)i * m + kk] = nvec[kk];
-            // incident wave (unit amplitude, e^{iwt}):
-            //   phi_I = (g/om) i Zr(z) e^{-i kw (x cos b + y sin b)}
-            // deep water: Zr = Zs = e^{kw z}; finite depth: cosh/sinh
-            // profile over the water column (kw = k0)
-            cdouble phase = std::exp(
-                cdouble(0.0, -kw * (rx * cos(beta) + ry * sin(beta))));
-            cdouble ph = cdouble(0.0, g / om) * Zr(rz) * phase;
-            // grad phi_I
-            cdouble ddx = ph * cdouble(0.0, -kw * cos(beta));
-            cdouble ddy = ph * cdouble(0.0, -kw * sin(beta));
-            cdouble ddz = cdouble(0.0, g / om) * kw * Zs(rz) * phase;
-            rhs[(size_t)i * m + 6] =
-                -(ddx * p.n[0] + ddy * p.n[1] + ddz * p.n[2]);
+            bool lid = i >= nh;
+            for (int kk = 0; kk < 6; kk++)
+                rhs[(size_t)i * m + kk] = lid ? 0.0 : nvec[kk];
+            for (int ib = 0; ib < nb; ib++) {
+                double cb = cos(betas[ib]), sb = sin(betas[ib]);
+                // incident wave (unit amplitude, e^{iwt}):
+                //   phi_I = (g/om) i Zr(z) e^{-i kw (x cos b + y sin b)}
+                // deep water: Zr = Zs = e^{kw z}; finite depth: cosh/sinh
+                // profile over the water column (kw = k0)
+                cdouble phase = std::exp(cdouble(0.0, -kw * (rx * cb + ry * sb)));
+                cdouble ph = cdouble(0.0, g / om) * Zr(rz) * phase;
+                // grad phi_I
+                cdouble ddx = ph * cdouble(0.0, -kw * cb);
+                cdouble ddy = ph * cdouble(0.0, -kw * sb);
+                cdouble ddz = cdouble(0.0, g / om) * kw * Zs(rz) * phase;
+                cdouble dn = ddx * p.n[0] + ddy * p.n[1] + ddz * p.n[2];
+                dphiI_dn[(size_t)i * nb + ib] = dn;
+                // hull: Neumann  dphi_S/dn = -dphi_I/dn
+                // lid:  Dirichlet phi_S = -phi_I  (zero interior total)
+                rhs[(size_t)i * m + 6 + ib] = lid ? -ph : -dn;
+            }
         }
         if (lu_solve(M, rhs, n, m) != 0) return -1;
-        // potentials phi = S sigma / (4 pi scale folded: none -- G carried
-        // its own normalization, sigma absorbed it)
+        // panel potentials phi = S sigma for ALL columns at once (one n^2 m
+        // pass instead of re-accumulating per coefficient pair)
+        std::vector<cdouble> phi((size_t)n * m, cdouble(0.0, 0.0));
+#ifdef _OPENMP
+#pragma omp parallel for schedule(static)
+#endif
+        for (int i = 0; i < n; i++)
+            for (int q = 0; q < n; q++) {
+                cdouble s = inf.S[(size_t)i * n + q];
+                for (int kk = 0; kk < m; kk++)
+                    phi[(size_t)i * m + kk] += s * rhs[(size_t)q * m + kk];
+            }
         // radiation coefficients: A - i B/om = rho Int phi_k n_j dS
         for (int kk = 0; kk < 6; kk++) {
             for (int j = 0; j < 6; j++) {
                 cdouble acc = 0.0;
-                for (int i = 0; i < n; i++) {
-                    cdouble phi = 0.0;
-                    for (int q = 0; q < n; q++)
-                        phi += inf.S[(size_t)i * n + q] * rhs[(size_t)q * m + kk];
+                for (int i = 0; i < nh; i++) {    // wetted hull only
                     const Panel& p = pan[i];
                     double nvec[6] = {
                         p.n[0], p.n[1], p.n[2],
@@ -881,7 +930,7 @@ int bem_solve(const double* panels, int np,
                         p.c[2] * p.n[0] - p.c[0] * p.n[2],
                         p.c[0] * p.n[1] - p.c[1] * p.n[0],
                     };
-                    acc += phi * nvec[j] * p.area;
+                    acc += phi[(size_t)i * m + kk] * nvec[j] * p.area;
                 }
                 // from -i w A - B = i w rho Int phi n dS (unit velocity):
                 //   A = -rho Re I,  B = +w rho Im I
@@ -890,31 +939,64 @@ int bem_solve(const double* panels, int np,
                 Bo[((size_t)iw * 6 + j) * 6 + kk] = val.imag() * om;
             }
         }
-        // excitation: X_j = -i om rho Int (phi_I + phi_S) n_j dS
-        for (int j = 0; j < 6; j++) {
-            cdouble acc = 0.0;
-            for (int i = 0; i < n; i++) {
-                const Panel& p = pan[i];
-                cdouble phiS = 0.0;
-                for (int q = 0; q < n; q++)
-                    phiS += inf.S[(size_t)i * n + q] * rhs[(size_t)q * m + 6];
-                cdouble phiI = cdouble(0.0, g / om) * Zr(p.c[2])
-                             * std::exp(cdouble(0.0, -kw * (p.c[0] * cos(beta) + p.c[1] * sin(beta))));
-                double nvec[6] = {
-                    p.n[0], p.n[1], p.n[2],
-                    p.c[1] * p.n[2] - p.c[2] * p.n[1],
-                    p.c[2] * p.n[0] - p.c[0] * p.n[2],
-                    p.c[0] * p.n[1] - p.c[1] * p.n[0],
-                };
-                acc += (phiI + phiS) * nvec[j] * p.area;
+        // excitation per heading:
+        //   direct:  X_j = i om rho Int (phi_I + phi_S) n_j dS
+        //   Haskind: X_j = i om rho Int (phi_I n_j - phi_j dphi_I/dn) dS
+        // (Green's identity on the radiation/scattering pair turns
+        //  Int phi_S n_j dS into -Int phi_j dphi_I/dn dS; agreement of the
+        //  two is a solver self-consistency check in amplitude AND phase)
+        for (int ib = 0; ib < nb; ib++) {
+            double cb = cos(betas[ib]), sb = sin(betas[ib]);
+            for (int j = 0; j < 6; j++) {
+                cdouble acc = 0.0, acch = 0.0;
+                for (int i = 0; i < nh; i++) {    // wetted hull only
+                    const Panel& p = pan[i];
+                    cdouble phiS = phi[(size_t)i * m + 6 + ib];
+                    cdouble phiI = cdouble(0.0, g / om) * Zr(p.c[2])
+                                 * std::exp(cdouble(0.0, -kw * (p.c[0] * cb + p.c[1] * sb)));
+                    double nvec[6] = {
+                        p.n[0], p.n[1], p.n[2],
+                        p.c[1] * p.n[2] - p.c[2] * p.n[1],
+                        p.c[2] * p.n[0] - p.c[0] * p.n[2],
+                        p.c[0] * p.n[1] - p.c[1] * p.n[0],
+                    };
+                    acc += (phiI + phiS) * nvec[j] * p.area;
+                    acch += (phiI * nvec[j]
+                             - phi[(size_t)i * m + j] * dphiI_dn[(size_t)i * nb + ib])
+                            * p.area;
+                }
+                cdouble X = cdouble(0.0, om) * rho * acc;
+                Fre[((size_t)iw * nb + ib) * 6 + j] = X.real();
+                Fim[((size_t)iw * nb + ib) * 6 + j] = X.imag();
+                if (Fhre && Fhim) {
+                    cdouble Xh = cdouble(0.0, om) * rho * acch;
+                    Fhre[((size_t)iw * nb + ib) * 6 + j] = Xh.real();
+                    Fhim[((size_t)iw * nb + ib) * 6 + j] = Xh.imag();
+                }
             }
-            // F = -Int p n dS = +i w rho Int (phi_I + phi_S) n dS
-            cdouble X = cdouble(0.0, om) * rho * acc;
-            Fre[(size_t)iw * 6 + j] = X.real();
-            Fim[(size_t)iw * 6 + j] = X.imag();
         }
     }
     return 0;
+}
+
+int bem_solve_mh(const double* panels, int np,
+                 const double* w, int nw, double depth,
+                 double rho, double g,
+                 const double* betas, int nb,
+                 double* A, double* Bo, double* Fre, double* Fim,
+                 double* Fhre, double* Fhim,
+                 int nthreads, int nlid) {
+    return solve_core(panels, np, w, nw, depth, rho, g, betas, nb,
+                      A, Bo, Fre, Fim, Fhre, Fhim, nthreads, nlid);
+}
+
+int bem_solve(const double* panels, int np,
+              const double* w, int nw, double depth,
+              double rho, double g, double beta,
+              double* A, double* Bo, double* Fre, double* Fim,
+              int nthreads) {
+    return solve_core(panels, np, w, nw, depth, rho, g, &beta, 1,
+                      A, Bo, Fre, Fim, nullptr, nullptr, nthreads, 0);
 }
 
 // backward-compatible deep-water entry

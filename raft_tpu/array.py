@@ -78,12 +78,24 @@ class ArrayModel:
     """
 
     def __init__(self, designs, positions=None, w=None, depth: float | None = None,
-                 nT: int | None = None):
+                 nT: int | None = None, BEM=None):
         if isinstance(designs, dict):
             if nT is None:
                 nT = len(positions) if positions is not None else 1
             designs = [designs] * nT
         self.designs = list(designs)
+        # BEM: None (pure Morison), 'native' (mesh + solve once, shared
+        # across turbines -- requires identical designs), or precomputed
+        # (A[6,6,nw], B[6,6,nw], F[6,nw]) host arrays.  Per-turbine incident
+        # phase is applied to the staged excitation at solve time.
+        if BEM is not None and any(d is not self.designs[0] for d in self.designs):
+            raise NotImplementedError(
+                "BEM in arrays requires identical turbine designs (shared "
+                "coefficients); mixed-design arrays run strip-theory only"
+            )
+        self.bem_mode = BEM if isinstance(BEM, str) else None
+        self.bem = BEM if not isinstance(BEM, str) else None
+        self._bem_staged = None
         self.nT = len(self.designs)
         if positions is None:
             positions = np.zeros((self.nT, 2))
@@ -135,9 +147,30 @@ class ArrayModel:
 
     # ------------------------------------------------------------- statics
 
+    def calcBEM(self, dz_max: float = 3.0, da_max: float = 2.0, irr: bool = False):
+        """One native BEM solve for the shared design, staged to every
+        turbine (cf. Model.calcBEM)."""
+        from raft_tpu.hydro.mesh import mesh_design, mesh_lid
+        from raft_tpu.hydro.native_bem import solve_bem
+
+        with phase("array-calcBEM"):
+            panels = mesh_design(self.designs[0], dz_max=dz_max, da_max=da_max)
+            if len(panels) == 0:
+                return None
+            lid = mesh_lid(self.designs[0], da_max=da_max) if irr else None
+            self.bem = solve_bem(
+                panels, np.asarray(self.w),
+                rho=float(self.env.rho), g=float(self.env.g),
+                beta=float(self.env.beta), depth=self.depth, lid=lid,
+            )
+        return self.bem
+
     def calcSystemProps(self):
         if self.wave is None:
             self.setEnv()
+        if self.bem_mode == "native" and self.bem is None:
+            self.calcBEM()
+        exclude = self.bem is not None
         env, wave = self.env, self.wave
         with phase("array-statics"):
             self.statics = jax.vmap(lambda m, r: assemble_statics(m, r, env))(
@@ -146,10 +179,22 @@ class ArrayModel:
         with phase("array-hydro-strip"):
             kin0 = jax.vmap(lambda m: node_kinematics(m, wave, env))(self.members)
             self.kin = jax.vmap(_phase_kin)(kin0, self.phases)
-            self.A_morison = jax.vmap(lambda m: strip_added_mass(m, env))(self.members)
+            self.A_morison = jax.vmap(
+                lambda m: strip_added_mass(m, env, exclude_potmod=exclude)
+            )(self.members)
             self.F_morison = jax.vmap(
-                lambda m, k: strip_excitation(m, k, env)
+                lambda m, k: strip_excitation(m, k, env, exclude_potmod=exclude)
             )(self.members, self.kin)
+        if self.bem is not None:
+            from raft_tpu.parallel import stage_bem
+
+            A_b, B_b, F_cx = stage_bem(self.bem, wave)       # F zeta-scaled
+            ph = self.phases                                  # (nT, nw) Cx
+            F_t = Cx(
+                ph.re[:, :, None] * F_cx.re[None] - ph.im[:, :, None] * F_cx.im[None],
+                ph.re[:, :, None] * F_cx.im[None] + ph.im[:, :, None] * F_cx.re[None],
+            )                                                 # (nT, nw, 6)
+            self._bem_staged = (A_b, B_b, F_t)
         with phase("array-mooring-stiffness"):
             z6 = jnp.zeros(6)
             C0 = [
@@ -230,20 +275,31 @@ class ArrayModel:
         nw = self.w.shape[0]
         s = self.statics
 
-        def lane(members, kin, A_mor, F_mor, M_struc, C_struc, C_hydro, C_moor):
+        staged = self._bem_staged
+
+        def lane(members, kin, A_mor, F_mor, M_struc, C_struc, C_hydro, C_moor,
+                 F_bem):
+            M = jnp.broadcast_to(M_struc + A_mor, (nw, 6, 6))
+            B = jnp.zeros((nw, 6, 6), dtype=A_mor.dtype)
+            F = F_mor
+            if staged is not None:
+                M = M + staged[0]                 # shared A_bem(w)
+                B = B + staged[1]                 # shared B_bem(w)
+                F = F + F_bem                     # per-turbine phased F_bem
             lin = LinearCoeffs(
-                M=jnp.broadcast_to(M_struc + A_mor, (nw, 6, 6)),
-                B=jnp.zeros((nw, 6, 6), dtype=A_mor.dtype),
-                C=C_struc + C_hydro + C_moor,
-                F=F_mor,
+                M=M, B=B, C=C_struc + C_hydro + C_moor, F=F,
             )
             return solve_dynamics(members, kin, wave, env, lin,
                                   n_iter=nIter, tol=tol, method=method)
 
+        F_bem_t = (
+            staged[2] if staged is not None
+            else Cx(jnp.zeros((self.nT, nw, 6)), jnp.zeros((self.nT, nw, 6)))
+        )
         with phase("array-rao-solve"):
             self.rao = jax.vmap(lane)(
                 self.members, self.kin, self.A_morison, self.F_morison,
-                s.M_struc, s.C_struc, s.C_hydro, self.C_moor,
+                s.M_struc, s.C_struc, s.C_hydro, self.C_moor, F_bem_t,
             )
         Xi = self.rao.Xi                                     # (nT, nw, 6)
         amp = np.asarray(Xi.abs())
@@ -261,6 +317,46 @@ class ArrayModel:
             "iterations": np.asarray(self.rao.n_iter),
         }
         return self
+
+    def print_report(self):
+        """Per-turbine summary report (cf. Model.print_report)."""
+        print(f"=== raft_tpu array report: {self.nT} turbines, "
+              f"nDOF {6 * self.nT} ===")
+        p = self.results.get("properties", {})
+        for t in range(self.nT):
+            x, y = self.positions[t]
+            print(f"  turbine {t}: position ({x:.1f}, {y:.1f}) m")
+            if "total mass" in p:
+                print(f"    mass {p['total mass'][t]:14.4g} kg   "
+                      f"displacement {p['displacement'][t]:12.4g} m^3")
+            if "eigen" in self.results:
+                T = self.results["eigen"]["periods"][t]
+                print("    periods [s]:", " ".join(f"{x:8.2f}" for x in T))
+            if "means" in self.results:
+                r6 = self.results["means"]["platform offset"][t]
+                print(f"    mean offset: surge {r6[0]:.2f} m, heave {r6[2]:.2f} m, "
+                      f"pitch {np.rad2deg(r6[4]):.2f} deg")
+            if "response" in self.results:
+                s = self.results["response"]["std dev"][t]
+                print("    response std dev:", " ".join(f"{x:9.4g}" for x in s))
+        print("=" * 40)
+
+    def plot(self, ax=None, hideGrid: bool = False, n_ring: int = 24):
+        """Wireframes of every turbine at its plan position."""
+        import matplotlib.pyplot as plt
+
+        from raft_tpu.model import plot_member_wireframe
+
+        if ax is None:
+            fig = plt.figure(figsize=(9, 9))
+            ax = fig.add_subplot(projection="3d")
+        for t in range(self.nT):
+            m_t = jax.tree.map(lambda x: x[t], self.members)
+            plot_member_wireframe(ax, m_t, offset=self.positions[t],
+                                  n_ring=n_ring)
+        if hideGrid:
+            ax.set_axis_off()
+        return ax
 
     def calcOutputs(self):
         if self.rao is None:

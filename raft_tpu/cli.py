@@ -37,6 +37,10 @@ def main(argv=None):
     p.add_argument("--dw", type=float, default=0.05)
     p.add_argument("--bem", action="store_true",
                    help="run the native BEM solver for potMod members")
+    p.add_argument("--irr", action="store_true",
+                   help="irregular-frequency removal (waterplane lid) in the BEM solve")
+    p.add_argument("--n-turbines", type=int, default=1,
+                   help="analyze N identical turbines as an array (nDOF = 6N)")
     p.add_argument("--plot", action="store_true")
     p.add_argument("--json", action="store_true", help="print results as JSON")
     args = p.parse_args(argv)
@@ -52,9 +56,12 @@ def main(argv=None):
         thrust = float(design.get("turbine", {}).get("Fthrust", 0.0))
 
     model = Model(design, w=np.arange(args.wmin, args.wmax, args.dw),
-                  BEM="native" if args.bem else None)
+                  BEM="native" if args.bem else None,
+                  nTurbines=args.n_turbines)
     model.setEnv(Hs=args.hs, Tp=args.tp, V=args.wind,
                  beta=np.deg2rad(args.beta), Fthrust=thrust)
+    if args.bem and args.irr:
+        model.calcBEM(irr=True)
     model.calcSystemProps()
     model.solveEigen()
     model.calcMooringAndOffsets()

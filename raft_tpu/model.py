@@ -54,18 +54,12 @@ class Model:
         # reference accepts nTurbines but hard-wires fowtList[0],
         # raft/raft.py:1292-1298; here arrays actually solve as 6N DOF)
         if nTurbines != 1:
-            if BEM is not None:
-                raise NotImplementedError(
-                    "BEM coefficients are not yet supported for multi-turbine "
-                    "arrays; run single-turbine models with BEM, or arrays "
-                    "strip-theory-only"
-                )
             from raft_tpu.array import ArrayModel
 
             if positions is None:
                 positions = (design or {}).get("array", {}).get("positions")
             return ArrayModel(design, positions=positions, w=w, depth=depth,
-                              nT=nTurbines)
+                              nT=nTurbines, BEM=BEM)
         return super().__new__(cls)
 
     def __init__(self, design: dict, w=None, depth: float | None = None,
@@ -447,35 +441,7 @@ class Model:
         if ax is None:
             fig = plt.figure(figsize=(8, 8))
             ax = fig.add_subplot(projection="3d")
-        m = self.members
-        keep = np.asarray(m.seg_mask & ~m.seg_is_cap)
-        rA = np.asarray(m.seg_rA)[keep]
-        q = np.asarray(m.seg_q)[keep]
-        R = np.asarray(m.seg_R)[keep]
-        L = np.asarray(m.seg_l)[keep]
-        dA = np.asarray(m.seg_dA)[keep]
-        dB = np.asarray(m.seg_dB)[keep]
-        circ = np.asarray(m.seg_circ)[keep]
-        th = np.linspace(0, 2 * np.pi, n_ring + 1)
-        for i in range(len(rA)):
-            rB_i = rA[i] + q[i] * L[i]
-            p1, p2 = R[i][:, 0], R[i][:, 1]
-            if circ[i]:
-                ringA = rA[i] + 0.5 * dA[i, 0] * (
-                    np.outer(np.cos(th), p1) + np.outer(np.sin(th), p2)
-                )
-                ringB = rB_i + 0.5 * dB[i, 0] * (
-                    np.outer(np.cos(th), p1) + np.outer(np.sin(th), p2)
-                )
-            else:
-                sq = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1], [1, 1]]) * 0.5
-                ringA = rA[i] + sq[:, :1] * dA[i, 0] * p1 + sq[:, 1:] * dA[i, 1] * p2
-                ringB = rB_i + sq[:, :1] * dB[i, 0] * p1 + sq[:, 1:] * dB[i, 1] * p2
-            ax.plot(*ringA.T, "k-", lw=0.6)
-            ax.plot(*ringB.T, "k-", lw=0.6)
-            step = max(1, len(ringA) // 8)
-            for j in range(0, len(ringA), step):
-                ax.plot(*np.stack([ringA[j], ringB[j]]).T, "k-", lw=0.4)
+        plot_member_wireframe(ax, self.members, n_ring=n_ring)
         if self.moor is not None:
             from raft_tpu.mooring import fairlead_positions, line_states
 
@@ -508,6 +474,40 @@ class Model:
             [x[:, None] * scale * u[None, :], z[:, None]], axis=1
         )
         ax.plot(*pts.T, "b-", lw=0.8)
+
+
+def plot_member_wireframe(ax, m, offset=(0.0, 0.0), n_ring: int = 24):
+    """Wireframe of a MemberSet's segments on a 3D axes (shared by Model
+    and ArrayModel plots): end rings + longitudinal edges per segment."""
+    keep = np.asarray(m.seg_mask & ~m.seg_is_cap)
+    off = np.array([offset[0], offset[1], 0.0])
+    rA = np.asarray(m.seg_rA)[keep] + off
+    q = np.asarray(m.seg_q)[keep]
+    R = np.asarray(m.seg_R)[keep]
+    L = np.asarray(m.seg_l)[keep]
+    dA = np.asarray(m.seg_dA)[keep]
+    dB = np.asarray(m.seg_dB)[keep]
+    circ = np.asarray(m.seg_circ)[keep]
+    th = np.linspace(0, 2 * np.pi, n_ring + 1)
+    for i in range(len(rA)):
+        rB_i = rA[i] + q[i] * L[i]
+        p1, p2 = R[i][:, 0], R[i][:, 1]
+        if circ[i]:
+            ringA = rA[i] + 0.5 * dA[i, 0] * (
+                np.outer(np.cos(th), p1) + np.outer(np.sin(th), p2)
+            )
+            ringB = rB_i + 0.5 * dB[i, 0] * (
+                np.outer(np.cos(th), p1) + np.outer(np.sin(th), p2)
+            )
+        else:
+            sq = np.array([[1, 1], [-1, 1], [-1, -1], [1, -1], [1, 1]]) * 0.5
+            ringA = rA[i] + sq[:, :1] * dA[i, 0] * p1 + sq[:, 1:] * dA[i, 1] * p2
+            ringB = rB_i + sq[:, :1] * dB[i, 0] * p1 + sq[:, 1:] * dB[i, 1] * p2
+        ax.plot(*ringA.T, "k-", lw=0.6)
+        ax.plot(*ringB.T, "k-", lw=0.6)
+        step = max(1, len(ringA) // 8)
+        for j in range(0, len(ringA), step):
+            ax.plot(*np.stack([ringA[j], ringB[j]]).T, "k-", lw=0.4)
 
 
 def load_design(fname: str) -> dict:

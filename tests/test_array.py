@@ -122,3 +122,44 @@ def test_array_outputs_nacelle_accel(pair):
     assert a_nac.shape == (2, len(W))
     assert np.isfinite(a_nac).all()
     np.testing.assert_allclose(a_nac[0], a_nac[1], rtol=1e-6, atol=1e-12)
+
+
+def test_array_with_staged_bem_matches_single():
+    """Two co-located turbines with staged BEM coefficients reproduce the
+    single-turbine BEM solve; a down-wave turbine's BEM excitation carries
+    the incident phase lag."""
+    design = load_design(OC3)
+    nw = len(W)
+    rng = np.random.default_rng(3)
+    A = np.zeros((6, 6, nw))
+    for i in range(6):
+        A[i, i] = 5e6 * (1e3 if i >= 3 else 1.0) / (1 + W**2)
+    B = np.zeros((6, 6, nw))
+    F = (rng.normal(size=(6, nw)) + 1j * rng.normal(size=(6, nw))) * 1e5
+
+    m1 = Model(design, w=W, BEM=(A, B, F))
+    m1.setEnv(Hs=8.0, Tp=12.0)
+    m1.calcSystemProps()
+    m1.calcMooringAndOffsets()
+    m1.solveDynamics(tol=1e-4)
+    Xi1 = np.asarray(m1.rao.Xi.to_complex())
+
+    d = 500.0
+    a = Model(design, w=W, nTurbines=2, BEM=(A, B, F),
+              positions=[[0.0, 0.0], [d, 0.0]])
+    a.setEnv(Hs=8.0, Tp=12.0)
+    a.calcSystemProps()
+    a.calcMooringAndOffsets()
+    a.solveDynamics(tol=1e-4)
+    Xa = a.results["response"]["Xi per turbine"]
+    np.testing.assert_allclose(Xa[0], Xi1, rtol=1e-5, atol=1e-9)
+    k = np.asarray(a.wave.k)
+    np.testing.assert_allclose(
+        Xa[1], Xi1 * np.exp(-1j * k[:, None] * d), rtol=2e-3, atol=1e-8
+    )
+
+
+def test_mixed_design_array_with_bem_raises():
+    d3, d4 = load_design(OC3), load_design(OC4)
+    with pytest.raises(NotImplementedError):
+        ArrayModel([d3, d4], w=W, BEM="native")

@@ -73,11 +73,16 @@ def _morison_active(m: MemberSet) -> Array:
 
     potMod members are served by the BEM provider instead — their strip
     added mass / FK excitation is gated off here, while drag (which no
-    potential-flow solver provides) stays on for all members.
+    potential-flow solver provides) stays on for all members.  Only
+    CIRCULAR potMod members are gated: the mesher routes rectangular
+    members to the Morison path regardless of their potMod flag
+    (hydro/mesh.py _iter_potmod_members), so gating them here would drop
+    them from both providers — e.g. the VolturnUS-S rectangular pontoons,
+    which carry ~25e6 kg of heave added mass.
     """
     act = _submerged(m)
     if m.node_potmod is not None:
-        act = act & ~m.node_potmod
+        act = act & ~(m.node_potmod & m.node_circ)
     return act
 
 

@@ -290,3 +290,45 @@ class TestAgainstOracle:
         dsm[i, 0] -= eps
         fd = (f(jnp.asarray(dsp)) - f(jnp.asarray(dsm))) / (2 * eps)
         np.testing.assert_allclose(np.asarray(g)[i, 0], fd, rtol=1e-5)
+
+
+def test_rect_potmod_members_stay_on_morison_path():
+    """The mesher routes rectangular members to the Morison path regardless
+    of potMod (only circular members are paneled), so the strip gate must
+    NOT exclude them — otherwise they vanish from both providers (the
+    VolturnUS-S pontoon bug: ~25e6 kg of heave added mass lost)."""
+    import numpy as np
+
+    from raft_tpu.build.members import build_member_set
+    from raft_tpu.core.types import Env
+    from raft_tpu.hydro import strip_added_mass
+
+    design = {
+        "platform": {
+            "members": [
+                {   # circular potMod column: gated out when BEM is staged
+                    "name": "col", "type": 2, "rA": [0, 0, -20], "rB": [0, 0, 10],
+                    "shape": "circ", "gamma": 0.0, "potMod": True,
+                    "stations": [0, 30], "d": 10.0, "t": 0.05,
+                    "Cd": 0.8, "Ca": 1.0, "CdEnd": 0.6, "CaEnd": 0.6,
+                    "rho_shell": 7850.0,
+                },
+                {   # rectangular potMod pontoon: must STAY on Morison
+                    "name": "pont", "type": 2, "rA": [5, 0, -17], "rB": [40, 0, -17],
+                    "shape": "rect", "gamma": 0.0, "potMod": True,
+                    "stations": [0, 35], "d": [[12.0, 7.0], [12.0, 7.0]], "t": 0.05,
+                    "Cd": [0.8, 0.8], "Ca": [1.0, 1.0], "CdEnd": 0.6, "CaEnd": 0.6,
+                    "rho_shell": 7850.0,
+                },
+            ]
+        }
+    }
+    m = build_member_set(design)
+    env = Env(depth=200.0)
+    A_all = np.asarray(strip_added_mass(m, env))
+    A_gated = np.asarray(strip_added_mass(m, env, exclude_potmod=True))
+    # the circular column's transverse added mass is gated off...
+    assert A_gated[0, 0] < 0.7 * A_all[0, 0]
+    # ...but the rect pontoon's heave added mass survives the gate
+    assert A_gated[2, 2] > 0.5 * A_all[2, 2]
+    assert A_gated[2, 2] > 1e6

@@ -283,3 +283,22 @@ def test_oc4_dynamics(oc4):
     # OC3 (~70 kN/m): expect offset of order 10 m
     r6 = oc4.results["means"]["platform offset"]
     assert 3.0 < r6[0] < 25.0
+
+
+@pytest.mark.slow
+def test_volturn_bem_natural_periods():
+    """VolturnUS-S with the native BEM on the circular columns (pontoons
+    rect -> Morison): published periods surge 142.9 s, heave 20.4 s,
+    pitch 27.8 s, yaw 90.7 s (Allen et al., Table 10).  Heave and pitch pin
+    at 5%; surge/yaw at 10% (quasi-static mooring linearization about zero
+    offset runs ~8% stiff of the published free-decay values)."""
+    m = Model(load_design("raft_tpu/designs/VolturnUS-S.yaml"), BEM="native",
+              w=np.linspace(0.05, 1.2, 8))
+    m.setEnv(Hs=8.0, Tp=12.0)
+    m.calcSystemProps()
+    m.solveEigen()
+    T = m.results["eigen"]["periods"]
+    assert T[2] == pytest.approx(20.4, rel=0.05)        # heave
+    assert T[4] == pytest.approx(27.8, rel=0.05)        # pitch
+    assert T[0] == pytest.approx(142.9, rel=0.10)       # surge
+    assert T[5] == pytest.approx(90.7, rel=0.10)        # yaw

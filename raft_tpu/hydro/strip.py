@@ -213,7 +213,8 @@ def node_motion(m: MemberSet, Xi: Cx, w: Array) -> Cx:
 
 
 def linearized_drag(
-    m: MemberSet, kin: StripKin, Xi: Cx, wave: WaveState, env: Env
+    m: MemberSet, kin: StripKin, Xi: Cx, wave: WaveState, env: Env,
+    axis_name: str | None = None,
 ) -> tuple[Array, Cx]:
     """Stochastically linearized Morison drag about the response iterate Xi.
 
@@ -223,14 +224,23 @@ def linearized_drag(
     spectrum is multiplied elementwise by the direction unit vector and the
     Frobenius norm is taken over (xyz, frequency) (raft/raft.py:2219-2227).
 
+    ``axis_name``: when the frequency grid is sharded over a mesh axis
+    (sequence parallelism inside ``shard_map``), the vRMS spectral moment is
+    the ONLY frequency reduction in the fixed point — it completes across
+    devices with a ``psum`` over that axis.
+
     Returns (B_drag (6,6) real damping, F_drag Cx (nw,6) drag excitation).
     """
+    import jax
+
     vnode = node_motion(m, Xi, wave.w)                          # (N,nw,3)
     vrel = kin.u - vnode
 
     def vrms(unit):                                             # unit: (N,3)
         w2 = unit[..., None, :] ** 2                            # (N,1,3)
         s = ((vrel.re**2 + vrel.im**2) * w2).sum(axis=(-1, -2))
+        if axis_name is not None:
+            s = jax.lax.psum(s, axis_name)          # complete over w shards
         # double-where so padded nodes (s == 0 exactly) don't poison the
         # backward pass with d(sqrt)/ds = inf at 0
         s_safe = jnp.where(s > 0, s, 1.0)

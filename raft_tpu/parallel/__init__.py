@@ -1,6 +1,7 @@
 """Multi-device parallelism: design-batch sweeps over a TPU mesh."""
 from raft_tpu.parallel.sweep import (  # noqa: F401
     forward_response,
+    forward_response_freq_sharded,
     grad_response_std,
     make_mesh,
     response_std,

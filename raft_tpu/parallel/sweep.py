@@ -18,6 +18,7 @@ import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from raft_tpu.core.cplx import Cx
 from raft_tpu.core.types import Env, MemberSet, RNA, WaveState
 from raft_tpu.hydro import node_kinematics, strip_added_mass, strip_excitation
 from raft_tpu.solve import LinearCoeffs, solve_dynamics
@@ -109,6 +110,92 @@ def forward_response(
         F=F,
     )
     return solve_dynamics(members, kin, wave, env, lin, n_iter=n_iter, method=method)
+
+
+def forward_response_freq_sharded(
+    members: MemberSet,
+    rna: RNA,
+    env: Env,
+    wave: WaveState,
+    C_moor: Array,
+    mesh: Mesh,
+    bem=None,
+    n_iter: int = 40,
+    method: str = "while",
+):
+    """Frequency-axis (sequence-parallel) RAO solve over a device mesh.
+
+    The reference's long axis is the frequency grid (serial loop,
+    raft/raft.py:1528); here it shards over the mesh's axis via
+    ``shard_map``: every device evaluates its own w-bins' kinematics,
+    excitation, and 6x6 impedance solves locally, while the two quantities
+    that couple bins — the drag linearization's spectral vRMS moment and
+    the convergence error — complete with one ``psum``/``pmax`` over ICI
+    per fixed-point iteration.  Bitwise-equivalent to the unsharded
+    :func:`forward_response` up to reduction order (sharded == unsharded
+    tested on an 8-device mesh).
+
+    Requires ``len(wave.w) % mesh.devices.size == 0``.  Compose with design
+    batching by using a 2-D mesh and ``vmap`` outside.
+    """
+    try:
+        from jax import shard_map                      # jax >= 0.4.35
+    except ImportError:                                # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+
+    axis = mesh.axis_names[0]
+    n_dev = int(np.prod(mesh.devices.shape))
+    nw = int(wave.w.shape[0])
+    if nw % n_dev != 0:
+        raise ValueError(f"nw={nw} not divisible by {n_dev} devices")
+    exclude = bem is not None
+    P_w = P(axis)
+    wave_specs = WaveState(w=P_w, k=P_w, zeta=P_w)
+    bem_specs = (P(axis), P(axis), Cx(P(axis), P(axis))) if bem is not None else None
+
+    from raft_tpu.solve.dynamics import RAOResult
+
+    out_specs = RAOResult(
+        Xi=Cx(P(axis), P(axis)),
+        n_iter=P(),
+        converged=P(),
+        B_drag=P(),
+        F_drag=Cx(P(axis), P(axis)),
+    )
+
+    def run(wave_l, bem_l):
+        stat = assemble_statics(members, rna, env)
+        kin = node_kinematics(members, wave_l, env)
+        A = strip_added_mass(members, env, exclude_potmod=exclude)
+        F = strip_excitation(members, kin, env, exclude_potmod=exclude)
+        nw_l = wave_l.w.shape[0]
+        M = jnp.broadcast_to(stat.M_struc + A, (nw_l, 6, 6))
+        B = jnp.zeros((nw_l, 6, 6), dtype=A.dtype)
+        if bem_l is not None:
+            M = M + bem_l[0]
+            B = B + bem_l[1]
+            F = F + bem_l[2]
+        lin = LinearCoeffs(M=M, B=B, C=stat.C_struc + stat.C_hydro + C_moor, F=F)
+        return solve_dynamics(members, kin, wave_l, env, lin,
+                              n_iter=n_iter, method=method, axis_name=axis)
+
+    kw = {}
+    try:
+        import inspect
+
+        if "check_rep" in inspect.signature(shard_map).parameters:
+            kw["check_rep"] = False
+        elif "check_vma" in inspect.signature(shard_map).parameters:
+            kw["check_vma"] = False
+    except (ValueError, TypeError):  # pragma: no cover
+        pass
+    sharded = shard_map(
+        run, mesh=mesh,
+        in_specs=(wave_specs, bem_specs),
+        out_specs=out_specs,
+        **kw,
+    )
+    return sharded(wave, bem)
 
 
 def response_std(Xi_abs2: Array, w: Array) -> Array:

@@ -79,7 +79,7 @@ def _error(Xi: Cx, Xi_last: Cx, tol: float) -> Array:
     return jnp.max(num / den)
 
 
-@partial(jax.jit, static_argnames=("n_iter", "tol", "relax", "method"))
+@partial(jax.jit, static_argnames=("n_iter", "tol", "relax", "method", "axis_name"))
 def solve_dynamics(
     m: MemberSet,
     kin: StripKin,
@@ -90,6 +90,7 @@ def solve_dynamics(
     tol: float = 0.01,
     relax: float = 0.8,
     method: str = "scan",
+    axis_name: str | None = None,
 ) -> RAOResult:
     """Solve Xi(w) by fixed-point drag linearization (raft/raft.py:1469-1552).
 
@@ -102,6 +103,12 @@ def solve_dynamics(
 
     Operates on one (design, sea state); batch with ``jax.vmap`` — each lane
     then gets its own convergence state for free.
+
+    ``axis_name``: set when the frequency grid is SHARDED over a mesh axis
+    (sequence parallelism via ``shard_map``): the drag linearization's
+    spectral moment completes with a ``psum`` and the convergence error
+    with a ``pmax`` over that axis, so every shard takes the same number
+    of iterations and reproduces the unsharded fixed point exactly.
     """
     nw = wave.w.shape[-1]
     dtype = lin.C.dtype
@@ -110,10 +117,13 @@ def solve_dynamics(
     Z0 = impedance(wave.w, lin.M, lin.B, lin.C)
 
     def step(Xi_last):
-        B_drag, F_drag = linearized_drag(m, kin, Xi_last, wave, env)
+        B_drag, F_drag = linearized_drag(m, kin, Xi_last, wave, env,
+                                         axis_name=axis_name)
         F = lin.F + F_drag
         Xi = _solve_once(Z0, wave.w, B_drag, F)
         err = _error(Xi, Xi_last, tol)
+        if axis_name is not None:
+            err = jax.lax.pmax(err, axis_name)      # global convergence
         return Xi, err
 
     def advance(carry):
@@ -139,5 +149,6 @@ def solve_dynamics(
     else:
         raise ValueError(f"unknown method {method!r}")
 
-    B_drag, F_drag = linearized_drag(m, kin, Xi_out, wave, env)
+    B_drag, F_drag = linearized_drag(m, kin, Xi_out, wave, env,
+                                     axis_name=axis_name)
     return RAOResult(Xi=Xi_out, n_iter=count, converged=done, B_drag=B_drag, F_drag=F_drag)

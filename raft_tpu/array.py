@@ -125,6 +125,33 @@ class ArrayModel:
         self.rao = None
         self.results: dict = {}
 
+    def addFOWT(self, design: dict, position=(0.0, 0.0)):
+        """Append one turbine to the array (cf. Model.addFOWT,
+        raft/raft.py:1292-1298 — where the reference grows ``fowtList`` and
+        ``nDOF`` but never solves the extra turbines, this rebuilds the
+        stacked axes so the whole array actually solves as 6(N+1) DOF).
+        Invalidates computed state; call ``setEnv``/``calcSystemProps``
+        again."""
+        if self.bem is not None and design is not self.designs[0]:
+            raise NotImplementedError(
+                "BEM arrays require identical turbine designs"
+            )
+        self.designs.append(design)
+        self.nT = len(self.designs)
+        self.positions = np.vstack([self.positions,
+                                    np.asarray(position, dtype=float)])
+        self.members, self.rna = stack_fowts(self.designs)
+        mo = design.get("mooring")
+        ys = float(design.get("turbine", {}).get("yaw_stiffness", 0.0))
+        self.moor.append(parse_mooring(mo, yaw_stiffness=ys) if mo else None)
+        self.wave = None
+        self.statics = None
+        self.kin = None
+        self.rao = None
+        self._bem_staged = None
+        self.results = {}
+        return self
+
     # ---------------------------------------------------------------- env
 
     def setEnv(self, Hs=8.0, Tp=12.0, V=10.0, beta=0.0, Fthrust=0.0):

@@ -238,6 +238,13 @@ class Model:
         }
         return self
 
+    def solveStatics(self):
+        """Mean static equilibrium (the reference declares this but leaves
+        it a stub, raft/raft.py:1454-1466; here it is the working mooring-
+        coupled equilibrium solve).  Alias of :meth:`calcMooringAndOffsets`,
+        kept for reference API parity."""
+        return self.calcMooringAndOffsets()
+
     # --------------------------------------------------------------- eigen
 
     def solveEigen(self, n_pass: int = 3):

@@ -163,3 +163,28 @@ def test_mixed_design_array_with_bem_raises():
     d3, d4 = load_design(OC3), load_design(OC4)
     with pytest.raises(NotImplementedError):
         ArrayModel([d3, d4], w=W, BEM="native")
+
+
+def test_add_fowt_grows_array():
+    """addFOWT rebuilds the stacked axes (cf. raft/raft.py:1292-1298, which
+    grows fowtList but never solves the extra turbines)."""
+    d = load_design(OC3)
+    a = ArrayModel(d, w=W)
+    assert a.nT == 1
+    a.addFOWT(d, position=(600.0, 0.0))
+    assert a.nT == 2
+    a.setEnv(Hs=8.0, Tp=12.0)
+    a.calcSystemProps()
+    a.solveEigen()
+    f = a.results["eigen"]["frequencies"]
+    assert f.shape == (2, 6)
+    np.testing.assert_allclose(f[0], f[1], rtol=1e-8)
+
+
+def test_model_solvestatics_alias():
+    m = Model(load_design(OC3), w=W)
+    m.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)
+    m.calcSystemProps()
+    m.solveStatics()
+    assert "means" in m.results
+    assert 10.0 < m.results["means"]["platform offset"][0] < 40.0

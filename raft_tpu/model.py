@@ -90,6 +90,7 @@ class Model:
         # hydro.bem_io.load_wamit_coeffs)
         self.bem_mode = BEM if isinstance(BEM, str) else None
         self.bem = BEM if not isinstance(BEM, str) else None
+        self._bem_headings = None        # staged heading grid (calcBEM)
         self.statics = None
         self.A_morison = None
         self.F_morison = None
@@ -120,20 +121,35 @@ class Model:
         self.f6Ext = jnp.array(
             [self.Fthrust, 0.0, 0.0, 0.0, self.Fthrust * hHub, 0.0]
         )
+        # environment changed: node kinematics and excitation are stale
+        # (they depend on the wave field incl. heading); statics are not
+        self.kin = None
+        self.F_morison = None
+        if self._bem_headings is not None and self.bem is not None:
+            # re-stage the excitation for the new heading from the grid --
+            # no BEM re-solve (A, B are heading-independent)
+            A, B = self._bem_headings[2], self._bem_headings[3]
+            self.bem = (A, B, self._heading_excitation(float(beta)))
 
     # ------------------------------------------------------------- statics
 
     def calcBEM(self, dz_max: float = 3.0, da_max: float = 2.0,
-                out_dir: str | None = None, irr: bool = False):
+                out_dir: str | None = None, irr: bool = False,
+                headings=None):
         """Mesh potMod members and run the native BEM solver
         (cf. FOWT.calcBEM, raft/raft.py:2016-2073 — where the reference
         leaves the solve commented out, this one runs).
 
         ``irr=True`` adds interior waterplane lid panels and the extended
         boundary integral equation, removing irregular frequencies (the
-        HAMS `irr` knob, hams/pyhams.py:200,284).  Writes HullMesh.pnl /
-        platform.gdf when ``out_dir`` is given, matching the reference's
-        on-disk artifacts."""
+        HAMS `irr` knob, hams/pyhams.py:200,284).  ``headings``: optional
+        heading grid [rad]; the excitation is solved for every heading in
+        one pass (the influence matrix factors once per frequency) and
+        later ``setEnv(beta=...)`` calls re-stage the matching excitation
+        by interpolation WITHOUT re-running the solver — the reference's
+        HAMS heading-grid workflow (hams/pyhams.py:196-289) carried through
+        the Model.  Writes HullMesh.pnl / platform.gdf when ``out_dir`` is
+        given, matching the reference's on-disk artifacts."""
         from raft_tpu.hydro.mesh import mesh_design, mesh_lid, write_gdf, write_pnl
         from raft_tpu.hydro.native_bem import solve_bem
 
@@ -150,12 +166,39 @@ class Model:
             lid = mesh_lid(self.design, da_max=da_max) if irr else None
             # finite-depth Green function below k0*depth = 10 (native
             # solver switches per frequency); deep water beyond
-            self.bem = solve_bem(
-                panels, np.asarray(self.w),
-                rho=float(self.env.rho), g=float(self.env.g),
-                beta=float(self.env.beta), depth=self.depth, lid=lid,
-            )
+            if headings is not None:
+                betas = np.sort(np.asarray(headings, dtype=float))
+                A, B, F_all = solve_bem(
+                    panels, np.asarray(self.w),
+                    rho=float(self.env.rho), g=float(self.env.g),
+                    beta=betas, depth=self.depth, lid=lid,
+                )
+                self._bem_headings = (betas, F_all, A, B)
+                self.bem = (A, B, self._heading_excitation(float(self.env.beta)))
+            else:
+                self.bem = solve_bem(
+                    panels, np.asarray(self.w),
+                    rho=float(self.env.rho), g=float(self.env.g),
+                    beta=float(self.env.beta), depth=self.depth, lid=lid,
+                )
         return self.bem
+
+    def _heading_excitation(self, beta: float) -> np.ndarray:
+        """Excitation F[6,nw] at heading ``beta`` from the staged grid
+        (linear interpolation in heading, per component)."""
+        betas, F_all, _, _ = self._bem_headings
+        if beta < betas[0] - 1e-9 or beta > betas[-1] + 1e-9:
+            raise ValueError(
+                f"heading {beta:.3f} rad outside staged grid "
+                f"[{betas[0]:.3f}, {betas[-1]:.3f}]"
+            )
+        nw = F_all.shape[-1]
+        F = np.empty((6, nw), dtype=complex)
+        for i in range(6):
+            for iw in range(nw):
+                F[i, iw] = np.interp(beta, betas, F_all[:, i, iw].real) + 1j * \
+                    np.interp(beta, betas, F_all[:, i, iw].imag)
+        return F
 
     def calcSystemProps(self):
         """Statics + strip-theory hydro + undisplaced mooring stiffness
@@ -345,7 +388,7 @@ class Model:
         # OC4 semi needs ~22 iterations from the 0.1 seed; the early-exit
         # driver makes the higher cap free for fast-converging cases
         """RAO fixed-point solve (cf. Model.solveDynamics, raft/raft.py:1469)."""
-        if self.statics is None:
+        if self.statics is None or self.kin is None:
             self.calcSystemProps()
         lin = self._linear_coeffs()
         with phase("rao-solve"):

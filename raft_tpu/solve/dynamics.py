@@ -79,7 +79,8 @@ def _error(Xi: Cx, Xi_last: Cx, tol: float) -> Array:
     return jnp.max(num / den)
 
 
-@partial(jax.jit, static_argnames=("n_iter", "tol", "relax", "method", "axis_name"))
+@partial(jax.jit, static_argnames=("n_iter", "tol", "relax", "method",
+                                   "axis_name", "remat"))
 def solve_dynamics(
     m: MemberSet,
     kin: StripKin,
@@ -91,6 +92,7 @@ def solve_dynamics(
     relax: float = 0.8,
     method: str = "scan",
     axis_name: str | None = None,
+    remat: bool = False,
 ) -> RAOResult:
     """Solve Xi(w) by fixed-point drag linearization (raft/raft.py:1469-1552).
 
@@ -103,6 +105,12 @@ def solve_dynamics(
 
     Operates on one (design, sea state); batch with ``jax.vmap`` — each lane
     then gets its own convergence state for free.
+
+    ``remat=True`` (scan path) rematerializes each fixed-point step on the
+    backward pass (``jax.checkpoint``): reverse-mode memory drops from
+    O(n_iter x drag-linearization residuals) to O(n_iter x Xi) at ~1
+    extra forward step per iteration — the trade for large design batches
+    against HBM.
 
     ``axis_name``: set when the frequency grid is SHARDED over a mesh axis
     (sequence parallelism via ``shard_map``): the drag linearization's
@@ -143,8 +151,9 @@ def solve_dynamics(
             lambda c: (~c[2]) & (c[3] < n_iter), advance, init
         )
     elif method == "scan":
+        step_fn = jax.checkpoint(advance) if remat else advance
         (_, Xi_out, done, count), _ = jax.lax.scan(
-            lambda c, _: (advance(c), None), init, None, length=n_iter
+            lambda c, _: (step_fn(c), None), init, None, length=n_iter
         )
     else:
         raise ValueError(f"unknown method {method!r}")

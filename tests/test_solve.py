@@ -315,3 +315,34 @@ def test_eigen_bem_added_mass_fixed_point():
                        for a in range(6)])
         out = _se(jnp.asarray(M_base + Ai), jnp.asarray(C_tot))
         assert abs(np.asarray(out.wns)[i] - wn) / wn < 1e-3
+
+
+def test_remat_gradient_matches():
+    """jax.checkpoint on the scan step must not change values or gradients
+    (it only trades memory for recompute)."""
+    m = build_member_set(cylinder_design())
+    env = Env(Hs=6.0, Tp=10.0, depth=300.0)
+    nw = 12
+    w = jnp.linspace(0.1, 2.5, nw)
+    wave = WaveState(w=w, k=wave_number(w, 300.0),
+                     zeta=jnp.sqrt(jonswap(w, 6.0, 10.0)))
+    kin = node_kinematics(m, wave, env)
+    A = strip_added_mass(m, env)
+    F = strip_excitation(m, kin, env)
+    M0 = jnp.eye(6) * 8e6 + A
+    C = jnp.diag(jnp.asarray([1e5, 1e5, 3e5, 5e9, 5e9, 1e8]))
+
+    def sigma(scale, remat):
+        lin = LinearCoeffs(
+            M=jnp.broadcast_to(M0 * scale, (nw, 6, 6)),
+            B=jnp.zeros((nw, 6, 6)),
+            C=C,
+            F=F,
+        )
+        out = solve_dynamics(m, kin, wave, env, lin, n_iter=12, remat=remat)
+        return jnp.sum(out.Xi.abs2())
+
+    v0, g0 = jax.value_and_grad(sigma)(1.0, False)
+    v1, g1 = jax.value_and_grad(sigma)(1.0, True)
+    np.testing.assert_allclose(float(v1), float(v0), rtol=1e-12)
+    np.testing.assert_allclose(float(g1), float(g0), rtol=1e-10)

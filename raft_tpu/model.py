@@ -567,12 +567,25 @@ def load_design(fname: str) -> dict:
         return yaml.safe_load(f)
 
 
-def run_raft(fname_design: str, plot: bool = False, w=None) -> dict:
-    """End-to-end analysis recipe (cf. runRAFT, raft/runRAFT.py:23-82)."""
+def run_raft(fname_design: str, fname_env: str | None = None,
+             plot: bool = False, w=None) -> dict:
+    """End-to-end analysis recipe (cf. runRAFT, raft/runRAFT.py:23-82).
+
+    ``fname_env``: optional environment YAML with ``Hs``/``Tp``/``V``/
+    ``beta`` [deg]/``Fthrust`` keys.  The reference accepts this argument
+    but never opens it (hard-coded sea state, raft/runRAFT.py:68); here it
+    is honored, with the reference's defaults when absent."""
     design = load_design(fname_design)
     model = Model(design, w=w)
     turb = design.get("turbine", {})
-    model.setEnv(Hs=8.0, Tp=12.0, V=10.0, Fthrust=float(turb.get("Fthrust", 0.0)))
+    envd = load_design(fname_env) if fname_env else {}
+    model.setEnv(
+        Hs=float(envd.get("Hs", 8.0)),
+        Tp=float(envd.get("Tp", 12.0)),
+        V=float(envd.get("V", 10.0)),
+        beta=float(np.deg2rad(envd.get("beta", 0.0))),
+        Fthrust=float(envd.get("Fthrust", turb.get("Fthrust", 0.0))),
+    )
     model.calcSystemProps()
     model.solveEigen()
     model.calcMooringAndOffsets()

@@ -100,3 +100,21 @@ def test_weis_adapter_end_to_end():
     assert m.results["properties"]["displacement"] == pytest.approx(
         oc3.results["properties"]["displacement"], rel=0.02
     )
+
+
+def test_run_raft_env_file(tmp_path):
+    """run_raft honors the environment YAML (the reference accepts the
+    argument but never opens it, raft/runRAFT.py:68)."""
+    import yaml
+
+    from raft_tpu.model import run_raft
+
+    envf = tmp_path / "env.yaml"
+    envf.write_text(yaml.safe_dump({"Hs": 3.0, "Tp": 9.0, "V": 5.0,
+                                    "beta": 0.0, "Fthrust": 2e5}))
+    w = np.arange(0.1, 2.5, 0.4)
+    res = run_raft("raft_tpu/designs/OC3spar.yaml", str(envf), w=w)
+    res8 = run_raft("raft_tpu/designs/OC3spar.yaml", w=w)
+    # milder sea state + less thrust: smaller offsets and responses
+    assert res["means"]["platform offset"][0] < res8["means"]["platform offset"][0]
+    assert res["response"]["std dev"][0] < res8["response"]["std dev"][0]

@@ -1,4 +1,15 @@
 """Multi-device parallelism: design-batch sweeps over a TPU mesh."""
+from raft_tpu.parallel.geometry import (  # noqa: F401
+    affine_warp,
+    make_scale_plan,
+    make_stretch_draft,
+    substructure_masks,
+)
+from raft_tpu.parallel.optimize import (  # noqa: F401
+    grad_nacelle_accel_std,
+    nacelle_accel_std,
+    optimize_design,
+)
 from raft_tpu.parallel.sweep import (  # noqa: F401
     forward_response,
     forward_response_freq_sharded,

@@ -1,0 +1,176 @@
+"""Geometry parameterizations for design sweeps and co-design gradients.
+
+The north-star workload (BASELINE.json) sweeps "draft/column-radius
+variants" of a platform.  On the stacked :class:`~raft_tpu.core.types.
+MemberSet` those are *value-only* transforms — node/segment counts never
+change — so one compiled sweep covers every variant and ``jax.grad`` flows
+through the knob (the shape-static invariant documented on MemberSet).
+
+All transforms here are anisotropic affine warps ``x' = o + D (x - o)``
+with a diagonal scale ``D``, applied to a subset of members (by default the
+substructure, never the tower):
+
+* positions (``seg_rA``, ``node_r``) warp directly;
+* orientations follow the warp: ``q' = D q / |D q|``, with the transverse
+  pair re-orthonormalized so rectangular members keep their twist;
+* lengths pick up the member's own stretch factor ``|D q|`` (segment
+  length, node lumped length, ballast fill length — the fill *fraction* is
+  preserved), while cross-section dims (diameters/side lengths) and end-cap
+  thicknesses stay fixed;
+* everything else (coefficients, masks, ids) is untouched.
+
+Because member ids live in traced arrays, the member-subset masks are
+extracted host-side once by a factory (``make_stretch_draft`` /
+``make_scale_plan``) and closed over — the returned ``fn(members, s)`` is
+then pure and jit/vmap/grad-safe, slotting straight into
+:func:`raft_tpu.parallel.sweep.sweep`'s ``apply_fn``.
+
+Verified relations (tests/test_geometry.py): for a fully-vertical spar a
+draft stretch anchored at the waterline scales displaced volume, shell and
+ballast mass exactly by ``s`` with the waterplane untouched; a plan-radius
+scale moves the OC4 offset columns out by exactly ``s`` and grows the
+spacing term of the waterplane inertia by ``s^2``.
+"""
+from __future__ import annotations
+
+import numpy as np
+import jax.numpy as jnp
+
+from raft_tpu.core.types import MemberSet
+
+Array = jnp.ndarray
+
+
+def _safe_normalize(v, fallback_axis: int):
+    """Normalize v, replacing zero rows (padding) by a fixed unit vector.
+
+    Double-where on the squared norm BEFORE the sqrt: padded members carry
+    all-zero frames, and the VJP of ``norm`` at 0 is 0 * (0/0) = NaN even
+    though the row's value is discarded downstream — the same guard pattern
+    as response_std (sweep.py).
+    """
+    n2 = jnp.sum(v * v, axis=-1, keepdims=True)
+    ok = n2 > 0
+    unit = jnp.zeros_like(v).at[..., fallback_axis].set(1.0)
+    v_s = jnp.where(ok, v, unit)
+    n = jnp.sqrt(jnp.where(ok, n2, 1.0))
+    return v_s / n, jnp.where(ok[..., 0], n[..., 0], 1.0)
+
+
+def _warp_frame(q, p1, D):
+    """Transform an orthonormal member frame through the diagonal map D.
+
+    q' is the normalized image of q; p1 is mapped and re-orthonormalized
+    against q' (preserving twist continuously); p2' closes the right-handed
+    triad.  Shapes: q, p1 (..., 3); D (3,).  Zero (padded) frames pass
+    through with stretch 1 and finite gradients.
+    """
+    qn, f = _safe_normalize(q * D, 2)
+    p1D = p1 * D
+    p1t = p1D - jnp.sum(p1D * qn, axis=-1, keepdims=True) * qn
+    p1n, _ = _safe_normalize(p1t, 0)
+    p2n = jnp.cross(qn, p1n)
+    return qn, p1n, p2n, f
+
+
+def affine_warp(
+    members: MemberSet,
+    scale3,
+    origin,
+    seg_sel: Array,
+    node_sel: Array,
+) -> MemberSet:
+    """Apply ``x' = o + D (x - o)`` to the selected members' geometry.
+
+    ``seg_sel`` (S,) / ``node_sel`` (N,) are boolean masks of which
+    segments/nodes move (concrete arrays from a factory, so the result
+    keeps MemberSet's static shapes).  End caps reposition and reorient but
+    keep their thickness ``seg_l`` (a stretched plate is not what a cap
+    bulkhead means physically).
+    """
+    D = jnp.asarray(scale3, dtype=members.seg_rA.dtype)
+    o = jnp.asarray(origin, dtype=members.seg_rA.dtype)
+
+    def pos(r):
+        return o + D * (r - o)
+
+    def pick(sel, new, old):
+        return jnp.where(sel[(...,) + (None,) * (new.ndim - sel.ndim)], new, old)
+
+    # segments: R columns are [p1, p2, q] (core/transforms.py:member_orientation)
+    p1 = members.seg_R[..., :, 0]
+    q_n, p1_n, p2_n, f_seg = _warp_frame(members.seg_q, p1, D)
+    R_n = jnp.stack([p1_n, p2_n, q_n], axis=-1)
+    stretch = jnp.where(members.seg_is_cap, 1.0, f_seg)
+    m = members.replace(
+        seg_rA=pick(seg_sel, pos(members.seg_rA), members.seg_rA),
+        seg_q=pick(seg_sel, q_n, members.seg_q),
+        seg_R=pick(seg_sel, R_n, members.seg_R),
+        seg_l=pick(seg_sel, members.seg_l * stretch, members.seg_l),
+        seg_l_fill=pick(seg_sel, members.seg_l_fill * stretch, members.seg_l_fill),
+    )
+
+    # nodes
+    qn_n, p1n_n, p2n_n, f_node = _warp_frame(members.node_q, members.node_p1, D)
+    return m.replace(
+        node_r=pick(node_sel, pos(members.node_r), members.node_r),
+        node_q=pick(node_sel, qn_n, members.node_q),
+        node_p1=pick(node_sel, p1n_n, members.node_p1),
+        node_p2=pick(node_sel, p2n_n, members.node_p2),
+        node_dls=pick(node_sel, members.node_dls * f_node, members.node_dls),
+    )
+
+
+def substructure_masks(members: MemberSet):
+    """Concrete (host-side) segment/node masks of the substructure members
+    (type code > 1; the tower is type <= 1, raft/raft.py:1898-1912).
+
+    Must be called on an untraced MemberSet (the factory pattern below);
+    the masks are then closed over by the pure per-variant transform.
+    """
+    seg_member = np.asarray(members.seg_member)
+    seg_type = np.asarray(members.seg_type)
+    seg_mask = np.asarray(members.seg_mask)
+    # padded segments carry member id -1 — scatter only the valid ones, or
+    # the pad's type 0 lands on the highest member id via negative indexing
+    n_mem = int(seg_member[seg_mask].max()) + 1
+    mem_type = np.zeros(n_mem, dtype=int)
+    mem_type[seg_member[seg_mask]] = seg_type[seg_mask]
+    seg_sel = (seg_type > 1) & seg_mask
+    node_member = np.clip(np.asarray(members.node_member), 0, n_mem - 1)
+    node_sel = (mem_type[node_member] > 1) & np.asarray(members.node_mask)
+    return jnp.asarray(seg_sel), jnp.asarray(node_sel)
+
+
+def make_stretch_draft(members: MemberSet, anchor: float = 0.0):
+    """Draft-stretch knob: ``fn(members, s)`` scales the substructure's
+    vertical extent about ``z = anchor`` (default: the waterline, so the
+    keel deepens while the waterplane is untouched).
+
+    On a fully-vertical hull (e.g. the OC3 spar) this scales displaced
+    volume, shell mass and ballast mass exactly by ``s``.
+    """
+    seg_sel, node_sel = substructure_masks(members)
+
+    def fn(m: MemberSet, s) -> MemberSet:
+        s = jnp.asarray(s)
+        D = jnp.stack([jnp.ones_like(s), jnp.ones_like(s), s])
+        return affine_warp(m, D, jnp.array([0.0, 0.0, anchor]), seg_sel, node_sel)
+
+    return fn
+
+
+def make_scale_plan(members: MemberSet):
+    """Column-radius knob: ``fn(members, s)`` scales the substructure's
+    plan (x, y) layout about the platform centerline — offset columns move
+    radially in/out by ``s``, horizontal pontoons stretch with them,
+    vertical members keep their diameters and drafts.
+    """
+    seg_sel, node_sel = substructure_masks(members)
+
+    def fn(m: MemberSet, s) -> MemberSet:
+        s = jnp.asarray(s)
+        D = jnp.stack([s, s, jnp.ones_like(s)])
+        return affine_warp(m, D, jnp.zeros(3), seg_sel, node_sel)
+
+    return fn

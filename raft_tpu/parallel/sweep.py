@@ -76,6 +76,7 @@ def forward_response(
     bem=None,
     n_iter: int = 25,
     method: str = "scan",
+    remat: bool = False,
 ):
     """Design -> RAO solve: the pure forward pipeline (statics through Xi).
 
@@ -109,7 +110,8 @@ def forward_response(
         C=stat.C_struc + stat.C_hydro + C_moor,
         F=F,
     )
-    return solve_dynamics(members, kin, wave, env, lin, n_iter=n_iter, method=method)
+    return solve_dynamics(members, kin, wave, env, lin, n_iter=n_iter,
+                          method=method, remat=remat)
 
 
 def forward_response_freq_sharded(

@@ -1,0 +1,86 @@
+"""Co-design optimization: objective correctness, exact gradients, descent.
+
+The capability under test is BASELINE.json configs[4] — "jax.grad of
+nacelle-accel std-dev w.r.t. platform geometry params" driving a WEIS-style
+inner loop.  Gradients are checked against central finite differences of
+the same pipeline; the optimizer is checked to actually descend its
+objective on the OC3 spar.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+import __graft_entry__ as ge
+from raft_tpu.mooring import mooring_stiffness, parse_mooring
+from raft_tpu.parallel import (
+    forward_response,
+    grad_nacelle_accel_std,
+    nacelle_accel_std,
+    optimize_design,
+)
+
+
+@pytest.fixture(scope="module")
+def oc3():
+    design, members, rna, env, wave = ge._base(nw=24)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    return members, rna, env, wave, C_moor
+
+
+def _sigma_nac(oc3, s):
+    members, rna, env, wave, C_moor = oc3
+    from raft_tpu.parallel import scale_diameters
+
+    out = forward_response(
+        scale_diameters(members, jnp.asarray(s)), rna, env, wave, C_moor,
+        n_iter=25, method="scan",
+    )
+    return float(nacelle_accel_std(out.Xi, wave, rna))
+
+
+def test_nacelle_objective_matches_manual_sum(oc3):
+    members, rna, env, wave, C_moor = oc3
+    out = forward_response(members, rna, env, wave, C_moor, n_iter=25)
+    sigma = float(nacelle_accel_std(out.Xi, wave, rna))
+    Xi = np.asarray(out.Xi.to_complex())
+    w = np.asarray(wave.w)
+    a = -(w**2) * (Xi[:, 0] + float(rna.hHub) * Xi[:, 4])
+    dw = float(w[1] - w[0])
+    assert sigma == pytest.approx(np.sqrt((np.abs(a) ** 2).sum() * dw), rel=1e-10)
+    assert sigma > 0.01                      # Hs=8 seas excite the nacelle
+
+
+def test_grad_matches_finite_difference(oc3):
+    members, rna, env, wave, C_moor = oc3
+    g = float(grad_nacelle_accel_std(members, rna, env, wave, C_moor, 1.0))
+    h = 1e-4
+    fd = (_sigma_nac(oc3, 1.0 + h) - _sigma_nac(oc3, 1.0 - h)) / (2 * h)
+    assert g == pytest.approx(fd, rel=2e-3)
+
+
+def test_optimizer_descends(oc3):
+    members, rna, env, wave, C_moor = oc3
+    res = optimize_design(
+        members, rna, env, wave, C_moor, theta0=1.0,
+        steps=6, learning_rate=0.02, bounds=(0.8, 1.25), n_iter=25,
+    )
+    assert res.history[-1] < res.history[0] - 1e-4, res.history
+    assert 0.8 <= float(res.theta) <= 1.25
+    assert np.isfinite(res.history).all()
+    # trajectory bookkeeping is consistent
+    assert res.thetas.shape[0] == res.history.shape[0] == 7
+    assert res.objective == pytest.approx(res.history[-1])
+
+
+def test_optimizer_remat_matches(oc3):
+    """remat only changes the backward-pass schedule, not values/grads."""
+    members, rna, env, wave, C_moor = oc3
+    a = optimize_design(members, rna, env, wave, C_moor, theta0=1.0,
+                        steps=2, learning_rate=0.02)
+    b = optimize_design(members, rna, env, wave, C_moor, theta0=1.0,
+                        steps=2, learning_rate=0.02, remat=True)
+    np.testing.assert_allclose(a.history, b.history, rtol=1e-12)
+    np.testing.assert_allclose(a.thetas, b.thetas, rtol=1e-12)

@@ -2,11 +2,12 @@
 
 Two workloads, both on one TPU chip:
 
-* **north star** (BASELINE.json): 1,000 VolturnUS-S design variants x 200
-  frequency bins through the full drag-linearized RAO fixed point, with the
-  native-BEM potential-flow coefficients A(w), B(w), F(w) precomputed on host
-  (coarse grid + interpolation, content-addressed cache) and staged as device
-  arrays.  Per-lane convergence is asserted.  Target: < 60 s wall-clock.
+* **north star** (BASELINE.json): 1,000 VolturnUS-S draft/column-radius
+  variants x 200 frequency bins through the full drag-linearized RAO fixed
+  point, with the native-BEM potential-flow coefficients A(w), B(w), F(w)
+  precomputed on host (coarse grid + interpolation, content-addressed cache)
+  and staged as device arrays.  Per-lane convergence is asserted.
+  Target: < 60 s wall-clock.
 * **oc3 strip**: 2,048 OC3-spar variants x 200 bins, strip theory only (the
   round-1/2 workload, kept for cross-round comparability).
 
@@ -85,33 +86,52 @@ def _volturn_setup(nw: int = 200, nw_bem: int = 24):
 
 def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
                chunk: int = 250):
-    """1k VolturnUS-S variants x 200 w with BEM staged; asserts convergence.
+    """1k VolturnUS-S draft/column-radius variants x 200 w with BEM staged.
 
-    The batch runs in ``chunk``-sized sub-batches (one compilation, reused)
-    so per-step HBM stays bounded: the dominant live tensors are the
-    per-lane node wave kinematics, ~6 MB x chunk for this hull/grid.
+    The variant axes are BASELINE.json's own ("1,000 VolturnUS-S
+    draft/column-radius variants"): a grid over draft stretch x plan-radius
+    scale via the shape-static affine warps (parallel/geometry.py), so all
+    1,000 geometries share one compiled solve.  Per-lane convergence is
+    asserted.  The batch runs in ``chunk``-sized sub-batches (one
+    compilation, reused) so per-step HBM stays bounded: the dominant live
+    tensors are the per-lane node wave kinematics, ~6 MB x chunk for this
+    hull/grid.
     """
     import jax
     import jax.numpy as jnp
 
-    from raft_tpu.parallel import forward_response, scale_diameters
+    from raft_tpu.parallel import (
+        forward_response, make_scale_plan, make_stretch_draft,
+    )
 
     design, members, rna, env, wave, C_moor, bem = setup or _volturn_setup(nw=nw)
     chunk = min(chunk, batch)
     while batch % chunk != 0:      # largest divisor of batch <= requested
         chunk -= 1
+    draft = make_stretch_draft(members)
+    plan = make_scale_plan(members)
 
-    def one(s):
+    def one(theta):
         # n_iter matches Model.solveDynamics' cap (the early-exit while
-        # driver makes the headroom free; typical lanes converge in ~10-15)
+        # driver makes the headroom free; typical lanes converge in ~8-15)
+        m = plan(draft(members, theta[1]), theta[0])
         out = forward_response(
-            scale_diameters(members, s), rna, env, wave, C_moor,
-            bem=bem, n_iter=40, method="while",
+            m, rna, env, wave, C_moor, bem=bem, n_iter=40, method="while",
         )
         return out.Xi.abs2(), out.converged, out.n_iter
 
     fwd = jax.jit(jax.vmap(one))
-    scales = jnp.linspace(0.9, 1.1, batch).reshape(batch // chunk, chunk)
+    # near-square grid over (plan radius, draft) covering +-10%
+    n_d = int(np.sqrt(batch))
+    while batch % n_d != 0:
+        n_d -= 1
+    n_p = batch // n_d
+    dd, pp = np.meshgrid(np.linspace(0.9, 1.1, n_d), np.linspace(0.9, 1.1, n_p))
+    scales = jnp.asarray(
+        np.stack([pp.ravel(), dd.ravel()], axis=1).reshape(
+            batch // chunk, chunk, 2
+        )
+    )
 
     def run_all():
         outs = [fwd(c) for c in scales]           # sequential chunks
@@ -134,6 +154,7 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
         "batch": batch,
         "nw": nw,
         "chunk": chunk,
+        "axes": f"plan_radius({n_p}) x draft({n_d}), +-10%",
         "wallclock_s": round(best, 4),
         "solves_per_s": round(batch * nw / best, 1),
         "converged_lanes": n_conv,

@@ -13,6 +13,8 @@ Deviations from the reference (documented, intentional):
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 
@@ -26,6 +28,7 @@ Array = jnp.ndarray
 _KH_DEEP = 89.4
 
 
+@jax.jit
 def jonswap(w: Array, Hs, Tp, gamma=1.0) -> Array:
     """One-sided JONSWAP wave power spectral density S(w) [m^2/(rad/s)].
 
@@ -43,6 +46,7 @@ def jonswap(w: Array, Hs, Tp, gamma=1.0) -> Array:
     )
 
 
+@partial(jax.jit, static_argnames=("iters",))
 def wave_number(w: Array, depth, g: float = 9.81, iters: int = 30) -> Array:
     """Wave number k(w, h) from the linear dispersion relation w^2 = g k tanh(k h).
 

@@ -32,6 +32,9 @@ Deviations from the reference (correct physics kept; see DEVIATIONS.md):
 """
 from __future__ import annotations
 
+from functools import partial
+
+import jax
 import jax.numpy as jnp
 from flax import struct
 
@@ -55,6 +58,7 @@ class StripKin:
     pDyn: Cx   # (N,nw)   dynamic pressure amplitudes
 
 
+@jax.jit
 def node_kinematics(m: MemberSet, wave: WaveState, env: Env) -> StripKin:
     """Evaluate wave kinematics at every strip node (cf. raft/raft.py:2100)."""
     u, ud, pDyn = wave_kinematics(
@@ -121,6 +125,7 @@ def _direction_mats(m: MemberSet):
     return vec_outer(m.node_q), vec_outer(m.node_p1), vec_outer(m.node_p2)
 
 
+@partial(jax.jit, static_argnames=("exclude_potmod",))
 def strip_added_mass(m: MemberSet, env: Env, exclude_potmod: bool = False) -> Array:
     """Morison added-mass matrix A (6,6) about the PRP.
 
@@ -156,6 +161,7 @@ def _translate_force_cx(r: Array, F: Cx) -> Cx:
     return Cx(translate_force_3to6(rb, F.re), translate_force_3to6(rb, F.im))
 
 
+@partial(jax.jit, static_argnames=("exclude_potmod",))
 def strip_excitation(
     m: MemberSet, kin: StripKin, env: Env, exclude_potmod: bool = False
 ) -> Cx:

@@ -20,6 +20,8 @@ reference pushes into the MoorPy body at raft/raft.py:2007-2011.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -112,6 +114,7 @@ def line_states(sys: MooringSystem, r6: Array) -> CatenaryState:
     return solve_catenary(xf, zf, sys.props)
 
 
+@jax.jit
 def mooring_force(sys: MooringSystem, r6: Array) -> Array:
     """Net 6-DOF mooring load on the platform at displacement r6.
 
@@ -130,6 +133,7 @@ def mooring_force(sys: MooringSystem, r6: Array) -> Array:
     return jnp.concatenate([F3.sum(axis=0), M3.sum(axis=0)])
 
 
+@jax.jit
 def mooring_stiffness(sys: MooringSystem, r6: Array) -> Array:
     """Linearized 6x6 mooring stiffness about r6: C = -d F_moor / d r6.
 
@@ -142,11 +146,13 @@ def mooring_stiffness(sys: MooringSystem, r6: Array) -> Array:
     return C.at[5, 5].add(sys.yaw_stiffness)
 
 
+@jax.jit
 def fairlead_tensions(sys: MooringSystem, r6: Array) -> Array:
     """Fairlead tension magnitude per line at platform displacement r6 (nl,)."""
     return line_states(sys, r6).Tf
 
 
+@jax.jit
 def tension_jacobian(sys: MooringSystem, r6: Array) -> Array:
     """d T_fairlead / d r6 — (nl, 6), exact via forward-mode autodiff.
 
@@ -158,6 +164,7 @@ def tension_jacobian(sys: MooringSystem, r6: Array) -> Array:
     return jax.jacfwd(lambda x: fairlead_tensions(sys, x))(r6)
 
 
+@partial(jax.jit, static_argnames=("iters",))
 def solve_equilibrium(
     sys: MooringSystem,
     F_const: Array,

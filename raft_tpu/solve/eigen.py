@@ -10,6 +10,8 @@ and batches/vmaps/differentiates cleanly.
 """
 from __future__ import annotations
 
+from functools import partial
+
 import jax
 import jax.numpy as jnp
 from flax import struct
@@ -53,6 +55,7 @@ def dominance_order(modes: Array) -> Array:
     return jnp.stack(picks, axis=-1)
 
 
+@jax.jit
 def diagonal_estimates(M_tot: Array, C_tot: Array) -> Array:
     """Per-DOF natural-frequency estimates from the diagonal entries [Hz].
 
@@ -94,6 +97,7 @@ def diagonal_estimates(M_tot: Array, C_tot: Array) -> Array:
     return jnp.sqrt(jnp.stack(w2, axis=-1)) / _TWO_PI
 
 
+@partial(jax.jit, static_argnames=("sweeps",))
 def solve_eigen(M_tot: Array, C_tot: Array, sweeps: int = 12) -> EigenResult:
     """Natural frequencies of the undamped 6-DOF system.
 

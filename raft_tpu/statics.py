@@ -19,6 +19,7 @@ Deviations from the reference (correct physics kept; see DEVIATIONS.md):
 """
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.frustum import frustum_moi, frustum_vcv
@@ -201,6 +202,7 @@ def segment_hydrostatics(m: MemberSet, env: Env):
     }
 
 
+@jax.jit
 def assemble_statics(m: MemberSet, rna: RNA, env: Env) -> RigidBodyCoeffs:
     """Full statics assembly (cf. FOWT.calcStatics, raft/raft.py:1836-2012)."""
     g = env.g

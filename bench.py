@@ -126,7 +126,11 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
     while batch % n_d != 0:
         n_d -= 1
     n_p = batch // n_d
-    dd, pp = np.meshgrid(np.linspace(0.9, 1.1, n_d), np.linspace(0.9, 1.1, n_p))
+
+    def axis(n):     # a 1-point axis sits at the nominal design, not 0.9
+        return np.linspace(0.9, 1.1, n) if n > 1 else np.array([1.0])
+
+    dd, pp = np.meshgrid(axis(n_d), axis(n_p))
     scales = jnp.asarray(
         np.stack([pp.ravel(), dd.ravel()], axis=1).reshape(
             batch // chunk, chunk, 2

@@ -293,7 +293,21 @@ class ArrayModel:
 
     # ------------------------------------------------------------ dynamics
 
-    def solveDynamics(self, nIter: int = 40, tol: float = 0.01, method="while"):
+    def solveDynamics(self, nIter: int = 40, tol: float = 0.01, method="while",
+                      mesh=None):
+        """RAO solve for every turbine in one vmapped call.
+
+        ``mesh``: optional 1-D ``jax.sharding.Mesh`` — the turbine axis is
+        pure data parallelism, so a wind farm shards across TPU chips by
+        placing each turbine's stacked inputs on its device (nT must be a
+        multiple of the mesh size); XLA keeps the whole solve local per
+        device with no collectives."""
+        if mesh is not None:
+            n_dev = int(np.prod(mesh.devices.shape))
+            if self.nT % n_dev != 0:
+                raise ValueError(
+                    f"nT={self.nT} not a multiple of the {n_dev}-device mesh"
+                )
         if self.statics is None:
             self.calcSystemProps()
         if self.C_moor is None:
@@ -324,10 +338,18 @@ class ArrayModel:
             else Cx(jnp.zeros((self.nT, nw, 6)), jnp.zeros((self.nT, nw, 6)))
         )
         with phase("array-rao-solve"):
-            self.rao = jax.vmap(lane)(
+            lane_args = (
                 self.members, self.kin, self.A_morison, self.F_morison,
                 s.M_struc, s.C_struc, s.C_hydro, self.C_moor, F_bem_t,
             )
+            if mesh is None:
+                self.rao = jax.vmap(lane)(*lane_args)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                sh = NamedSharding(mesh, P(mesh.axis_names[0]))
+                lane_args = jax.device_put(lane_args, sh)
+                self.rao = jax.jit(jax.vmap(lane), in_shardings=sh)(*lane_args)
         Xi = self.rao.Xi                                     # (nT, nw, 6)
         amp = np.asarray(Xi.abs())
         zeta = np.maximum(np.asarray(wave.zeta), 1e-12)

@@ -34,6 +34,38 @@ def test_cli_json(capsys):
     assert "eigen" in data and "response" in data
 
 
+@pytest.mark.slow
+def test_cli_sweep_json(capsys):
+    import json
+
+    from raft_tpu.cli import main
+
+    rows = main(["sweep", "oc3", "--param", "draft", "--lo", "0.95",
+                 "--hi", "1.05", "-n", "4",
+                 "--wmin", "0.2", "--wmax", "1.4", "--dw", "0.2"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["param"] == "draft"
+    assert len(out["theta"]) == 4 and len(out["std dev"]) == 4
+    sig = np.asarray(rows["std dev"])
+    assert np.isfinite(sig).all() and (sig[:, 0] > 0).all()
+
+
+@pytest.mark.slow
+def test_cli_optimize_json(capsys):
+    import json
+
+    from raft_tpu.cli import main
+
+    res = main(["optimize", "oc3", "--params", "diameter", "draft",
+                "--steps", "2", "--wmin", "0.2", "--wmax", "1.4",
+                "--dw", "0.2"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["params"] == ["diameter", "draft"]
+    assert len(out["theta"]) == 2
+    assert len(res.history if hasattr(res, "history") else res["history"]) == 3
+    assert res["history"][-1] <= res["history"][0] + 1e-12
+
+
 def test_print_report(capsys):
     m = Model(load_design("raft_tpu/designs/OC3spar.yaml"),
               w=np.arange(0.2, 1.2, 0.2))

@@ -181,6 +181,30 @@ def test_add_fowt_grows_array():
     np.testing.assert_allclose(f[0], f[1], rtol=1e-8)
 
 
+def test_array_mesh_sharded_matches_unsharded():
+    """Wind-farm data parallelism: the turbine axis sharded over a 4-device
+    mesh reproduces the unsharded farm exactly (no cross-turbine coupling,
+    so no collectives — pure placement)."""
+    import jax
+    from jax.sharding import Mesh
+
+    a = ArrayModel(load_design(OC3), positions=[[0, 0], [400, 0],
+                                                [800, 0], [1200, 0]], w=W)
+    a.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)
+    a.calcSystemProps()
+    a.calcMooringAndOffsets()
+    a.solveDynamics()
+    Xi_ref = np.asarray(a.rao.Xi.to_complex())
+
+    mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("turbines",))
+    a.solveDynamics(mesh=mesh)
+    Xi_sh = np.asarray(a.rao.Xi.to_complex())
+    np.testing.assert_allclose(Xi_sh, Xi_ref, rtol=1e-12, atol=1e-14)
+
+    with pytest.raises(ValueError, match="not a multiple"):
+        ArrayModel(load_design(OC3), nT=3, w=W).solveDynamics(mesh=mesh)
+
+
 def test_model_solvestatics_alias():
     m = Model(load_design(OC3), w=W)
     m.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)

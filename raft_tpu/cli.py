@@ -165,7 +165,7 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     # subcommand dispatch; a design file literally named like a subcommand
     # still wins (analyze ./sweep by path) because existing paths short-circuit
-    if argv and argv[0] in ("sweep", "optimize") and not os.path.exists(argv[0]):
+    if argv and argv[0] in ("sweep", "optimize") and not os.path.isfile(argv[0]):
         return {"sweep": main_sweep, "optimize": main_optimize}[argv[0]](argv[1:])
     p = argparse.ArgumentParser(
         description="raft_tpu frequency-domain analysis",

@@ -15,8 +15,10 @@ from raft_tpu.parallel.sweep import (  # noqa: F401
     forward_response_freq_sharded,
     grad_response_std,
     make_mesh,
+    make_wave_states,
     response_std,
     scale_diameters,
     stage_bem,
     sweep,
+    sweep_sea_states,
 )

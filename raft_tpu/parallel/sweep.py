@@ -47,6 +47,17 @@ def scale_diameters(members: MemberSet, scale: Array) -> MemberSet:
     )
 
 
+def _bem_device_layout(bem):
+    """Host WAMIT-reader layout (A[6,6,nw], B[6,6,nw], F[6,nw] complex) ->
+    frequency-leading device arrays (A[nw,6,6], B[nw,6,6], F_re/F_im[nw,6]),
+    excitation NOT yet zeta-scaled."""
+    A_bem, B_bem, F_bem = bem
+    A = jnp.asarray(np.moveaxis(np.asarray(A_bem), -1, 0))
+    B = jnp.asarray(np.moveaxis(np.asarray(B_bem), -1, 0))
+    Fb = np.moveaxis(np.asarray(F_bem), -1, 0)          # (nw,6) complex, host
+    return A, B, jnp.asarray(Fb.real), jnp.asarray(Fb.imag)
+
+
 def stage_bem(bem, wave: WaveState):
     """Host-layout BEM coefficients -> device arrays for the sweep.
 
@@ -58,13 +69,9 @@ def stage_bem(bem, wave: WaveState):
     """
     from raft_tpu.core.cplx import Cx
 
-    A_bem, B_bem, F_bem = bem
-    A = jnp.asarray(np.moveaxis(np.asarray(A_bem), -1, 0))
-    B = jnp.asarray(np.moveaxis(np.asarray(B_bem), -1, 0))
-    Fb = np.moveaxis(np.asarray(F_bem), -1, 0)          # (nw,6) complex, host
-    zeta = np.asarray(wave.zeta)[:, None]
-    F = Cx(jnp.asarray(zeta * Fb.real), jnp.asarray(zeta * Fb.imag))
-    return A, B, F
+    A, B, F_re, F_im = _bem_device_layout(bem)
+    zeta = jnp.asarray(np.asarray(wave.zeta))[:, None]
+    return A, B, Cx(zeta * F_re, zeta * F_im)
 
 
 def forward_response(
@@ -198,6 +205,71 @@ def forward_response_freq_sharded(
         **kw,
     )
     return sharded(wave, bem)
+
+
+def make_wave_states(w, cases, depth, g: float = 9.81) -> WaveState:
+    """Stack (Hs, Tp) sea states into one batched WaveState.
+
+    ``cases``: (B, 2) array-like of [Hs, Tp] rows — e.g. a design-load-case
+    table.  Returns a WaveState whose ``zeta`` has a leading case axis
+    (``w``/``k`` are broadcast), ready for :func:`sweep_sea_states`.
+    """
+    w = jnp.asarray(w, dtype=float)
+    cases = np.asarray(cases, dtype=float).reshape(-1, 2)
+    from raft_tpu.core.waves import jonswap, wave_number
+
+    k = wave_number(w, depth, g=g)
+    zeta = jnp.stack([jnp.sqrt(jonswap(w, Hs, Tp)) for Hs, Tp in cases])
+    B = zeta.shape[0]
+    return WaveState(
+        w=jnp.broadcast_to(w, (B,) + w.shape),
+        k=jnp.broadcast_to(k, (B,) + k.shape),
+        zeta=zeta,
+    )
+
+
+def sweep_sea_states(
+    members: MemberSet,
+    rna: RNA,
+    env: Env,
+    waves: WaveState,
+    C_moor: Array,
+    bem=None,
+    n_iter: int = 25,
+):
+    """One design x a batch of sea states in a single compiled call — the
+    design-load-case (DLC) table evaluation of a WEIS outer loop.
+
+    ``waves``: batched WaveState from :func:`make_wave_states`.  The wave
+    kinematics, excitation, and the whole drag-linearized fixed point (the
+    drag linearization is sea-state-dependent) are vmapped over the case
+    axis.  Note the staged ``bem`` excitation is zeta-scaled, so it must be
+    staged per case — pass the raw coefficient tuple and this function
+    stages it under the vmap.
+    """
+
+    # pre-convert the coefficient layout once on host so the vmapped body
+    # is pure jnp: the zeta scaling (the only sea-state-dependent part of
+    # the staging) happens per case lane
+    staged = _bem_device_layout(bem) if bem is not None else None
+
+    def one(wave):
+        b = None
+        if staged is not None:
+            A, B, F_re, F_im = staged
+            zeta = wave.zeta[:, None]
+            b = (A, B, Cx(zeta * F_re, zeta * F_im))
+        out = forward_response(members, rna, env, wave, C_moor, bem=b,
+                               n_iter=n_iter)
+        return out.Xi.abs2(), out.n_iter
+
+    abs2, iters = jax.jit(jax.vmap(one))(waves)
+    sigma = response_std(abs2, waves.w[0])
+    return {
+        "std dev": np.asarray(sigma),
+        "iterations": np.asarray(iters),
+        "Xi_abs2": np.asarray(abs2),
+    }
 
 
 def response_std(Xi_abs2: Array, w: Array) -> Array:

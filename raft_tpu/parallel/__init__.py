@@ -12,6 +12,7 @@ from raft_tpu.parallel.optimize import (  # noqa: F401
 )
 from raft_tpu.parallel.sweep import (  # noqa: F401
     forward_response,
+    forward_response_dp_sp,
     forward_response_freq_sharded,
     grad_response_std,
     make_mesh,

@@ -58,6 +58,15 @@ def _bem_device_layout(bem):
     return A, B, jnp.asarray(Fb.real), jnp.asarray(Fb.imag)
 
 
+def _stage_zeta(staged, zeta):
+    """Scale device-layout BEM excitation onto the spectral-amplitude basis
+    (zeta = sqrt(S)) used by the Morison path.  Traceable — ``zeta`` may be
+    a tracer (per-case staging under vmap in :func:`sweep_sea_states`)."""
+    A, B, F_re, F_im = staged
+    z = jnp.asarray(zeta)[:, None]
+    return A, B, Cx(z * F_re, z * F_im)
+
+
 def stage_bem(bem, wave: WaveState):
     """Host-layout BEM coefficients -> device arrays for the sweep.
 
@@ -67,11 +76,7 @@ def stage_bem(bem, wave: WaveState):
     amplitude basis (zeta = sqrt(S)) used by the Morison path — the
     BASELINE.json "precomputed on host and staged as device arrays" step.
     """
-    from raft_tpu.core.cplx import Cx
-
-    A, B, F_re, F_im = _bem_device_layout(bem)
-    zeta = jnp.asarray(np.asarray(wave.zeta))[:, None]
-    return A, B, Cx(zeta * F_re, zeta * F_im)
+    return _stage_zeta(_bem_device_layout(bem), wave.zeta)
 
 
 def forward_response(
@@ -121,6 +126,45 @@ def forward_response(
                           method=method, remat=remat)
 
 
+def _shard_map():
+    try:
+        from jax import shard_map                      # jax >= 0.4.35
+    except ImportError:                                # pragma: no cover
+        from jax.experimental.shard_map import shard_map
+    kw = {}
+    try:
+        import inspect
+
+        if "check_rep" in inspect.signature(shard_map).parameters:
+            kw["check_rep"] = False
+        elif "check_vma" in inspect.signature(shard_map).parameters:
+            kw["check_vma"] = False
+    except (ValueError, TypeError):  # pragma: no cover
+        pass
+    return shard_map, kw
+
+
+def _local_freq_solve(members, rna, env, wave_l, C_moor, bem_l, exclude,
+                      n_iter, method, axis):
+    """RAO solve on this device's frequency shard (collectives over ``axis``
+    complete the drag linearization's spectral moment and the convergence
+    check — see solve_dynamics)."""
+    stat = assemble_statics(members, rna, env)
+    kin = node_kinematics(members, wave_l, env)
+    A = strip_added_mass(members, env, exclude_potmod=exclude)
+    F = strip_excitation(members, kin, env, exclude_potmod=exclude)
+    nw_l = wave_l.w.shape[0]
+    M = jnp.broadcast_to(stat.M_struc + A, (nw_l, 6, 6))
+    B = jnp.zeros((nw_l, 6, 6), dtype=A.dtype)
+    if bem_l is not None:
+        M = M + bem_l[0]
+        B = B + bem_l[1]
+        F = F + bem_l[2]
+    lin = LinearCoeffs(M=M, B=B, C=stat.C_struc + stat.C_hydro + C_moor, F=F)
+    return solve_dynamics(members, kin, wave_l, env, lin,
+                          n_iter=n_iter, method=method, axis_name=axis)
+
+
 def forward_response_freq_sharded(
     members: MemberSet,
     rna: RNA,
@@ -144,14 +188,11 @@ def forward_response_freq_sharded(
     :func:`forward_response` up to reduction order (sharded == unsharded
     tested on an 8-device mesh).
 
-    Requires ``len(wave.w) % mesh.devices.size == 0``.  Compose with design
-    batching by using a 2-D mesh and ``vmap`` outside.
+    Requires ``len(wave.w) % mesh.devices.size == 0``.  For composed
+    design x frequency parallelism over a 2-D mesh see
+    :func:`forward_response_dp_sp`.
     """
-    try:
-        from jax import shard_map                      # jax >= 0.4.35
-    except ImportError:                                # pragma: no cover
-        from jax.experimental.shard_map import shard_map
-
+    shard_map, kw = _shard_map()
     axis = mesh.axis_names[0]
     n_dev = int(np.prod(mesh.devices.shape))
     nw = int(wave.w.shape[0])
@@ -173,31 +214,9 @@ def forward_response_freq_sharded(
     )
 
     def run(wave_l, bem_l):
-        stat = assemble_statics(members, rna, env)
-        kin = node_kinematics(members, wave_l, env)
-        A = strip_added_mass(members, env, exclude_potmod=exclude)
-        F = strip_excitation(members, kin, env, exclude_potmod=exclude)
-        nw_l = wave_l.w.shape[0]
-        M = jnp.broadcast_to(stat.M_struc + A, (nw_l, 6, 6))
-        B = jnp.zeros((nw_l, 6, 6), dtype=A.dtype)
-        if bem_l is not None:
-            M = M + bem_l[0]
-            B = B + bem_l[1]
-            F = F + bem_l[2]
-        lin = LinearCoeffs(M=M, B=B, C=stat.C_struc + stat.C_hydro + C_moor, F=F)
-        return solve_dynamics(members, kin, wave_l, env, lin,
-                              n_iter=n_iter, method=method, axis_name=axis)
+        return _local_freq_solve(members, rna, env, wave_l, C_moor, bem_l,
+                                 exclude, n_iter, method, axis)
 
-    kw = {}
-    try:
-        import inspect
-
-        if "check_rep" in inspect.signature(shard_map).parameters:
-            kw["check_rep"] = False
-        elif "check_vma" in inspect.signature(shard_map).parameters:
-            kw["check_vma"] = False
-    except (ValueError, TypeError):  # pragma: no cover
-        pass
     sharded = shard_map(
         run, mesh=mesh,
         in_specs=(wave_specs, bem_specs),
@@ -205,6 +224,81 @@ def forward_response_freq_sharded(
         **kw,
     )
     return sharded(wave, bem)
+
+
+def forward_response_dp_sp(
+    members: MemberSet,
+    rna: RNA,
+    env: Env,
+    wave: WaveState,
+    C_moor: Array,
+    thetas: Array,
+    mesh: Mesh,
+    apply_fn=scale_diameters,
+    bem=None,
+    n_iter: int = 40,
+    method: str = "while",
+):
+    """Composed design x frequency parallelism over a 2-D device mesh.
+
+    The scaling-book layout for this workload: ``mesh.axis_names[0]`` is
+    the data-parallel design axis (each device row owns a slice of the
+    design batch — embarrassingly parallel, no collectives), and
+    ``mesh.axis_names[1]`` is the sequence-parallel frequency axis (each
+    device column owns a slice of the w grid; the drag linearization's
+    spectral moment and the convergence check complete with ``psum``/
+    ``pmax`` over that axis per fixed-point iteration).  One ``shard_map``
+    over the 2-D mesh with an inner ``vmap`` over the local design lanes.
+
+    Requires ``len(thetas)`` divisible by the design-axis size and
+    ``len(wave.w)`` divisible by the frequency-axis size.  Returns the
+    RAOResult with a leading design-batch axis; agrees with a vmapped
+    :func:`forward_response` up to reduction order.
+    """
+    shard_map, kw = _shard_map()
+    if mesh.devices.ndim != 2:
+        raise ValueError(
+            f"forward_response_dp_sp needs a 2-D mesh (design x frequency "
+            f"axes); got shape {mesh.devices.shape} with axes {mesh.axis_names}"
+        )
+    axis_d, axis_f = mesh.axis_names
+    n_d, n_f = mesh.devices.shape
+    B = int(np.asarray(thetas).shape[0])
+    nw = int(wave.w.shape[0])
+    if B % n_d != 0:
+        raise ValueError(f"design batch {B} not divisible by {n_d} (axis {axis_d!r})")
+    if nw % n_f != 0:
+        raise ValueError(f"nw={nw} not divisible by {n_f} (axis {axis_f!r})")
+    exclude = bem is not None
+    P_w = P(axis_f)
+    wave_specs = WaveState(w=P_w, k=P_w, zeta=P_w)
+    bem_specs = (P(axis_f), P(axis_f), Cx(P(axis_f), P(axis_f))) if bem is not None else None
+
+    from raft_tpu.solve.dynamics import RAOResult
+
+    out_specs = RAOResult(
+        Xi=Cx(P(axis_d, axis_f), P(axis_d, axis_f)),
+        n_iter=P(axis_d),
+        converged=P(axis_d),
+        B_drag=P(axis_d),
+        F_drag=Cx(P(axis_d, axis_f), P(axis_d, axis_f)),
+    )
+
+    def run(th_l, wave_l, bem_l):
+        return jax.vmap(
+            lambda t: _local_freq_solve(
+                apply_fn(members, t), rna, env, wave_l, C_moor, bem_l,
+                exclude, n_iter, method, axis_f,
+            )
+        )(th_l)
+
+    sharded = shard_map(
+        run, mesh=mesh,
+        in_specs=(P(axis_d), wave_specs, bem_specs),
+        out_specs=out_specs,
+        **kw,
+    )
+    return sharded(thetas, wave, bem)
 
 
 def make_wave_states(w, cases, depth, g: float = 9.81) -> WaveState:
@@ -240,13 +334,18 @@ def sweep_sea_states(
     """One design x a batch of sea states in a single compiled call — the
     design-load-case (DLC) table evaluation of a WEIS outer loop.
 
-    ``waves``: batched WaveState from :func:`make_wave_states`.  The wave
-    kinematics, excitation, and the whole drag-linearized fixed point (the
-    drag linearization is sea-state-dependent) are vmapped over the case
-    axis.  Note the staged ``bem`` excitation is zeta-scaled, so it must be
-    staged per case — pass the raw coefficient tuple and this function
-    stages it under the vmap.
+    ``waves``: batched WaveState from :func:`make_wave_states` — all cases
+    must share one uniform frequency grid (checked; the response integral
+    uses a single dw).  The wave kinematics, excitation, and the whole
+    drag-linearized fixed point (the drag linearization is sea-state-
+    dependent) are vmapped over the case axis.  Note the staged ``bem``
+    excitation is zeta-scaled, so it must be staged per case — pass the raw
+    coefficient tuple and this function stages it under the vmap.
     """
+    w_rows = np.asarray(waves.w)
+    if not (w_rows == w_rows[0]).all():
+        raise ValueError("sweep_sea_states requires one shared frequency "
+                         "grid across cases (make_wave_states builds one)")
 
     # pre-convert the coefficient layout once on host so the vmapped body
     # is pure jnp: the zeta scaling (the only sea-state-dependent part of
@@ -254,11 +353,7 @@ def sweep_sea_states(
     staged = _bem_device_layout(bem) if bem is not None else None
 
     def one(wave):
-        b = None
-        if staged is not None:
-            A, B, F_re, F_im = staged
-            zeta = wave.zeta[:, None]
-            b = (A, B, Cx(zeta * F_re, zeta * F_im))
+        b = _stage_zeta(staged, wave.zeta) if staged is not None else None
         out = forward_response(members, rna, env, wave, C_moor, bem=b,
                                n_iter=n_iter)
         return out.Xi.abs2(), out.n_iter

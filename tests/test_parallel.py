@@ -92,6 +92,42 @@ def test_sea_state_sweep_with_bem_matches_staged_single():
         np.testing.assert_allclose(out["std dev"][i], sig, rtol=1e-12)
 
 
+@pytest.mark.slow
+def test_2d_mesh_dp_sp_matches_unsharded():
+    """Composed design x frequency parallelism: a (2, 4) mesh — design
+    batch data-parallel over rows, frequency grid sequence-parallel over
+    columns — reproduces the single-device vmapped solve."""
+    import __graft_entry__ as ge
+    from jax.sharding import Mesh
+    from raft_tpu.parallel import (
+        forward_response, forward_response_dp_sp, scale_diameters,
+    )
+
+    design, members, rna, env, wave = ge._base(nw=8)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                axis_names=("designs", "freq"))
+    thetas = jnp.asarray([0.92, 0.98, 1.04, 1.1])
+
+    out = forward_response_dp_sp(members, rna, env, wave, C_moor, thetas,
+                                 mesh=mesh)
+    ref = jax.vmap(
+        lambda s: forward_response(scale_diameters(members, s), rna, env,
+                                   wave, C_moor, n_iter=40, method="while")
+    )(thetas)
+    np.testing.assert_allclose(np.asarray(out.Xi.re), np.asarray(ref.Xi.re),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(out.Xi.im), np.asarray(ref.Xi.im),
+                               rtol=1e-9, atol=1e-12)
+    assert out.Xi.re.shape == (4, 8, 6)
+    with pytest.raises(ValueError, match="not divisible"):
+        forward_response_dp_sp(members, rna, env, wave, C_moor,
+                               jnp.ones(3), mesh=mesh)
+
+
 def test_sweep_sharded_matches_single():
     members, rna, env, wave, C_moor = setup()
     assert len(jax.devices()) == 8

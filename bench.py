@@ -36,7 +36,10 @@ def _volturn_setup(nw: int = 200, nw_bem: int = 24):
     panel solver (cached content-addressed) and interpolated to the model
     grid — the reference's own staging pattern (its Capytaine fixture holds
     28 frequencies that get interpolated to the design grid,
-    tests/test_capytaine_integration.py:36-78).
+    tests/test_capytaine_integration.py:36-78).  The staged coefficients
+    are those of the nominal hull, applied across the +-10% geometry
+    variants: the standard linearized-sweep approximation (re-running the
+    panel solver per variant is exactly what staging exists to avoid).
     """
     import jax.numpy as jnp
 

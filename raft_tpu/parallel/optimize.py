@@ -107,6 +107,12 @@ def optimize_design(
     ``bounds=(lo, hi)`` projects theta back into the box after each update
     (clipped gradient descent), keeping geometry scales physical.
 
+    With ``bem`` staged, the potential-flow coefficients are those of the
+    nominal hull and are held constant under differentiation — the gradient
+    carries the statics/Morison/drag dependence on theta (the linearized-
+    sweep convention; re-solving the panel method per step is what staging
+    avoids).
+
     Returns the parameter/objective trajectory so callers can inspect
     convergence rather than trust a single terminal value.
     """

@@ -330,9 +330,12 @@ def sweep_sea_states(
     C_moor: Array,
     bem=None,
     n_iter: int = 25,
+    mesh: Mesh | None = None,
 ):
     """One design x a batch of sea states in a single compiled call — the
     design-load-case (DLC) table evaluation of a WEIS outer loop.
+    ``mesh``: optional 1-D device mesh; the case axis is embarrassingly
+    parallel and shards across it (case count divisible by mesh size).
 
     ``waves``: batched WaveState from :func:`make_wave_states` — all cases
     must share one uniform frequency grid (checked; the response integral
@@ -358,7 +361,19 @@ def sweep_sea_states(
                                n_iter=n_iter)
         return out.Xi.abs2(), out.n_iter
 
-    abs2, iters = jax.jit(jax.vmap(one))(waves)
+    if mesh is not None:
+        if mesh.devices.ndim != 1:
+            raise ValueError(f"sweep_sea_states expects a 1-D mesh; got "
+                             f"shape {mesh.devices.shape}")
+        n_dev = int(mesh.devices.shape[0])
+        B = int(waves.zeta.shape[0])
+        if B % n_dev != 0:
+            raise ValueError(f"{B} sea states not divisible by {n_dev} devices")
+        sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
+        fn = jax.jit(jax.vmap(one), in_shardings=sharding)
+    else:
+        fn = jax.jit(jax.vmap(one))
+    abs2, iters = fn(waves)
     sigma = response_std(abs2, waves.w[0])
     return {
         "std dev": np.asarray(sigma),

@@ -128,6 +128,64 @@ def test_2d_mesh_dp_sp_matches_unsharded():
                                jnp.ones(3), mesh=mesh)
 
 
+def test_sea_state_sweep_sharded_matches_unsharded():
+    import __graft_entry__ as ge
+    from jax.sharding import Mesh
+    from raft_tpu.parallel import make_wave_states, sweep_sea_states
+
+    design, members, rna, env, wave = ge._base(nw=12)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    cases = [[h, 8.0 + h / 2] for h in (2.0, 4.0, 6.0, 8.0)]
+    waves = make_wave_states(np.asarray(wave.w), cases, float(env.depth))
+    ref = sweep_sea_states(members, rna, env, waves, C_moor)
+    mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("cases",))
+    out = sweep_sea_states(members, rna, env, waves, C_moor, mesh=mesh)
+    np.testing.assert_allclose(out["std dev"], ref["std dev"], rtol=1e-12)
+    with pytest.raises(ValueError, match="not divisible"):
+        sweep_sea_states(members, rna, env,
+                         make_wave_states(np.asarray(wave.w), cases[:3],
+                                          float(env.depth)),
+                         C_moor, mesh=mesh)
+
+
+@pytest.mark.slow
+def test_2d_mesh_dp_sp_with_bem_matches_unsharded():
+    """dp_sp with staged BEM coefficients == the vmapped staged solve."""
+    import __graft_entry__ as ge
+    from jax.sharding import Mesh
+    from raft_tpu.parallel import (
+        forward_response, forward_response_dp_sp, scale_diameters, stage_bem,
+    )
+
+    design, members, rna, env, wave = ge._base(nw=8)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    rng = np.random.default_rng(1)
+    A = np.tile(np.eye(6)[:, :, None] * 4e6, (1, 1, 8))
+    B = np.tile(np.eye(6)[:, :, None] * 2e5, (1, 1, 8))
+    F = (rng.normal(size=(6, 8)) + 1j * rng.normal(size=(6, 8))) * 2e5
+    bem = stage_bem((A, B, F), wave)
+    mesh = Mesh(np.array(jax.devices()[:8]).reshape(2, 4),
+                axis_names=("designs", "freq"))
+    thetas = jnp.asarray([0.95, 1.05])
+    out = forward_response_dp_sp(members, rna, env, wave, C_moor, thetas,
+                                 mesh=mesh, bem=bem)
+    ref = jax.vmap(
+        lambda s: forward_response(scale_diameters(members, s), rna, env,
+                                   wave, C_moor, bem=bem, n_iter=40,
+                                   method="while")
+    )(thetas)
+    np.testing.assert_allclose(np.asarray(out.Xi.re), np.asarray(ref.Xi.re),
+                               rtol=1e-9, atol=1e-12)
+    np.testing.assert_allclose(np.asarray(out.Xi.im), np.asarray(ref.Xi.im),
+                               rtol=1e-9, atol=1e-12)
+
+
 def test_sweep_sharded_matches_single():
     members, rna, env, wave, C_moor = setup()
     assert len(jax.devices()) == 8

@@ -100,6 +100,7 @@ class ArrayModel:
         if positions is None:
             positions = np.zeros((self.nT, 2))
         self.positions = np.asarray(positions, dtype=float).reshape(self.nT, 2)
+        self._bem_headings = None        # staged heading grid (calcBEM)
         self.members, self.rna = stack_fowts(self.designs)
         self.moor = []
         for d in self.designs:
@@ -155,6 +156,14 @@ class ArrayModel:
     # ---------------------------------------------------------------- env
 
     def setEnv(self, Hs=8.0, Tp=12.0, V=10.0, beta=0.0, Fthrust=0.0):
+        # validate BEFORE mutating any state: a heading outside the staged
+        # grid must leave the model exactly as it was (cf. Model.setEnv)
+        F_beta = None
+        if self._bem_headings is not None and self.bem is not None:
+            from raft_tpu.model import interp_heading_excitation
+
+            betas_g, F_all_g = self._bem_headings[0], self._bem_headings[1]
+            F_beta = interp_heading_excitation(betas_g, F_all_g, float(beta))
         self.env = Env(Hs=float(Hs), Tp=float(Tp), V=float(V), beta=float(beta),
                        depth=self.depth)
         S = jonswap(self.w, Hs, Tp)
@@ -170,13 +179,27 @@ class ArrayModel:
         self.f6Ext = jnp.stack([
             jnp.array([self.Fthrust, 0, 0, 0, self.Fthrust * h, 0]) for h in hubs
         ])
+        # environment changed: kinematics, excitation and the phased BEM
+        # staging are stale (cf. Model.setEnv); statics are not
+        self.kin = None
+        self.F_morison = None
+        self._bem_staged = None
+        if F_beta is not None:
+            # re-stage the excitation for the new heading from the grid —
+            # no BEM re-solve (A, B are heading-independent)
+            self.bem = (self._bem_headings[2], self._bem_headings[3], F_beta)
         return self
 
     # ------------------------------------------------------------- statics
 
-    def calcBEM(self, dz_max: float = 3.0, da_max: float = 2.0, irr: bool = False):
+    def calcBEM(self, dz_max: float = 3.0, da_max: float = 2.0, irr: bool = False,
+                headings=None):
         """One native BEM solve for the shared design, staged to every
-        turbine (cf. Model.calcBEM)."""
+        turbine (cf. Model.calcBEM).  ``headings``: optional heading grid
+        [rad] — the excitation solves for every heading in one pass
+        (influence matrix factored once per frequency) and later
+        ``setEnv(beta=...)`` calls re-stage by interpolation without
+        re-running the solver."""
         from raft_tpu.hydro.mesh import mesh_design, mesh_lid
         from raft_tpu.hydro.native_bem import solve_bem
 
@@ -185,11 +208,19 @@ class ArrayModel:
             if len(panels) == 0:
                 return None
             lid = mesh_lid(self.designs[0], da_max=da_max) if irr else None
-            self.bem = solve_bem(
-                panels, np.asarray(self.w),
-                rho=float(self.env.rho), g=float(self.env.g),
-                beta=float(self.env.beta), depth=self.depth, lid=lid,
-            )
+            if headings is not None:
+                from raft_tpu.model import solve_bem_heading_grid
+
+                self._bem_headings, self.bem = solve_bem_heading_grid(
+                    panels, self.w, float(self.env.rho), float(self.env.g),
+                    self.depth, lid, headings, float(self.env.beta),
+                )
+            else:
+                self.bem = solve_bem(
+                    panels, np.asarray(self.w),
+                    rho=float(self.env.rho), g=float(self.env.g),
+                    beta=float(self.env.beta), depth=self.depth, lid=lid,
+                )
         return self.bem
 
     def calcSystemProps(self):
@@ -308,7 +339,7 @@ class ArrayModel:
                 raise ValueError(
                     f"nT={self.nT} not a multiple of the {n_dev}-device mesh"
                 )
-        if self.statics is None:
+        if self.statics is None or self.kin is None:
             self.calcSystemProps()
         if self.C_moor is None:
             self.C_moor = self.C_moor0

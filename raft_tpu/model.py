@@ -108,6 +108,11 @@ class Model:
 
     def setEnv(self, Hs=8.0, Tp=12.0, V=10.0, beta=0.0, Fthrust=0.0):
         """Sea state + wind (cf. FOWT.setEnv, raft/raft.py:1804-1832)."""
+        # validate BEFORE mutating any state: a heading outside the staged
+        # grid must leave the model exactly as it was
+        F_beta = None
+        if self._bem_headings is not None and self.bem is not None:
+            F_beta = self._heading_excitation(float(beta))
         self.env = Env(
             Hs=float(Hs), Tp=float(Tp), V=float(V), beta=float(beta),
             depth=self.depth,
@@ -125,11 +130,11 @@ class Model:
         # (they depend on the wave field incl. heading); statics are not
         self.kin = None
         self.F_morison = None
-        if self._bem_headings is not None and self.bem is not None:
+        if F_beta is not None:
             # re-stage the excitation for the new heading from the grid --
             # no BEM re-solve (A, B are heading-independent)
             A, B = self._bem_headings[2], self._bem_headings[3]
-            self.bem = (A, B, self._heading_excitation(float(beta)))
+            self.bem = (A, B, F_beta)
 
     # ------------------------------------------------------------- statics
 
@@ -167,14 +172,10 @@ class Model:
             # finite-depth Green function below k0*depth = 10 (native
             # solver switches per frequency); deep water beyond
             if headings is not None:
-                betas = np.sort(np.asarray(headings, dtype=float))
-                A, B, F_all = solve_bem(
-                    panels, np.asarray(self.w),
-                    rho=float(self.env.rho), g=float(self.env.g),
-                    beta=betas, depth=self.depth, lid=lid,
+                self._bem_headings, self.bem = solve_bem_heading_grid(
+                    panels, self.w, float(self.env.rho), float(self.env.g),
+                    self.depth, lid, headings, float(self.env.beta),
                 )
-                self._bem_headings = (betas, F_all, A, B)
-                self.bem = (A, B, self._heading_excitation(float(self.env.beta)))
             else:
                 self.bem = solve_bem(
                     panels, np.asarray(self.w),
@@ -184,21 +185,8 @@ class Model:
         return self.bem
 
     def _heading_excitation(self, beta: float) -> np.ndarray:
-        """Excitation F[6,nw] at heading ``beta`` from the staged grid
-        (linear interpolation in heading, per component)."""
         betas, F_all, _, _ = self._bem_headings
-        if beta < betas[0] - 1e-9 or beta > betas[-1] + 1e-9:
-            raise ValueError(
-                f"heading {beta:.3f} rad outside staged grid "
-                f"[{betas[0]:.3f}, {betas[-1]:.3f}]"
-            )
-        nw = F_all.shape[-1]
-        F = np.empty((6, nw), dtype=complex)
-        for i in range(6):
-            for iw in range(nw):
-                F[i, iw] = np.interp(beta, betas, F_all[:, i, iw].real) + 1j * \
-                    np.interp(beta, betas, F_all[:, i, iw].imag)
-        return F
+        return interp_heading_excitation(betas, F_all, beta)
 
     def calcSystemProps(self):
         """Statics + strip-theory hydro + undisplaced mooring stiffness
@@ -558,6 +546,42 @@ def plot_member_wireframe(ax, m, offset=(0.0, 0.0), n_ring: int = 24):
         step = max(1, len(ringA) // 8)
         for j in range(0, len(ringA), step):
             ax.plot(*np.stack([ringA[j], ringB[j]]).T, "k-", lw=0.4)
+
+
+def solve_bem_heading_grid(panels, w, rho, g, depth, lid, headings, beta):
+    """Solve radiation once + diffraction for a whole heading grid, and
+    stage the excitation at the current heading.
+
+    Shared staging protocol of Model.calcBEM and ArrayModel.calcBEM:
+    returns ``(bem_headings, bem)`` where ``bem_headings = (betas,
+    F_all[nb,6,nw], A, B)`` is the grid for later re-staging and ``bem``
+    is the (A, B, F[6,nw]) tuple at ``beta``.
+    """
+    from raft_tpu.hydro.native_bem import solve_bem
+
+    betas = np.sort(np.asarray(headings, dtype=float))
+    A, B, F_all = solve_bem(panels, np.asarray(w), rho=rho, g=g,
+                            beta=betas, depth=depth, lid=lid)
+    bem_headings = (betas, F_all, A, B)
+    return bem_headings, (A, B, interp_heading_excitation(betas, F_all, beta))
+
+
+def interp_heading_excitation(betas, F_all, beta: float) -> np.ndarray:
+    """Excitation F[6,nw] at heading ``beta`` from a staged heading grid
+    (linear interpolation in heading, per component; shared by Model and
+    ArrayModel re-staging)."""
+    if beta < betas[0] - 1e-9 or beta > betas[-1] + 1e-9:
+        raise ValueError(
+            f"heading {beta:.3f} rad outside staged grid "
+            f"[{betas[0]:.3f}, {betas[-1]:.3f}]"
+        )
+    nw = F_all.shape[-1]
+    F = np.empty((6, nw), dtype=complex)
+    for i in range(6):
+        for iw in range(nw):
+            F[i, iw] = np.interp(beta, betas, F_all[:, i, iw].real) + 1j * \
+                np.interp(beta, betas, F_all[:, i, iw].imag)
+    return F
 
 
 def load_design(fname: str) -> dict:

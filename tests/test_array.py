@@ -205,6 +205,46 @@ def test_array_mesh_sharded_matches_unsharded():
         ArrayModel(load_design(OC3), nT=3, w=W).solveDynamics(mesh=mesh)
 
 
+@pytest.mark.slow
+def test_array_heading_grid_restages_without_resolve(monkeypatch):
+    """calcBEM(headings=[...]) on an array: setEnv(beta) re-stages the
+    excitation by interpolation with NO second native solve, and staleness
+    of the phased staging is honored."""
+    import raft_tpu.array as arr_mod
+    from raft_tpu.hydro import native_bem
+
+    design = load_design(OC3)
+    a = ArrayModel(design, positions=[[0, 0], [500, 0]], w=np.arange(0.2, 1.4, 0.3))
+    a.setEnv(Hs=8.0, Tp=12.0, beta=0.0)
+    calls = {"n": 0}
+    real = native_bem.solve_bem
+
+    def counting(*args, **kw):
+        calls["n"] += 1
+        return real(*args, **kw)
+
+    monkeypatch.setattr(native_bem, "solve_bem", counting)
+    betas = np.deg2rad([0.0, 30.0])
+    a.calcBEM(dz_max=6.0, da_max=6.0, headings=betas)
+    assert calls["n"] == 1
+    a.calcSystemProps()
+    a.solveDynamics()
+    Xi0 = np.asarray(a.rao.Xi.to_complex())
+
+    a.setEnv(Hs=8.0, Tp=12.0, beta=float(betas[1]))   # re-stage, no re-solve
+    assert calls["n"] == 1
+    assert a.kin is None and a._bem_staged is None     # staleness honored
+    a.calcSystemProps()
+    a.solveDynamics()
+    Xi1 = np.asarray(a.rao.Xi.to_complex())
+    assert np.abs(Xi0 - Xi1).max() > 1e-6              # heading changed response
+    # out-of-grid heading raises BEFORE mutating any state
+    with pytest.raises(ValueError, match="outside staged grid"):
+        a.setEnv(beta=1.0)
+    assert float(a.env.beta) == pytest.approx(float(betas[1]))
+    assert a.kin is not None                            # staging untouched
+
+
 def test_model_solvestatics_alias():
     m = Model(load_design(OC3), w=W)
     m.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)

@@ -216,7 +216,8 @@ class ArrayModel:
                     self.depth, lid, headings, float(self.env.beta),
                 )
             else:
-                self.bem = solve_bem(
+                self._bem_headings = None      # a fresh single-heading solve
+                self.bem = solve_bem(          # supersedes any staged grid
                     panels, np.asarray(self.w),
                     rho=float(self.env.rho), g=float(self.env.g),
                     beta=float(self.env.beta), depth=self.depth, lid=lid,

@@ -177,7 +177,8 @@ class Model:
                     self.depth, lid, headings, float(self.env.beta),
                 )
             else:
-                self.bem = solve_bem(
+                self._bem_headings = None      # a fresh single-heading solve
+                self.bem = solve_bem(          # supersedes any staged grid
                     panels, np.asarray(self.w),
                     rho=float(self.env.rho), g=float(self.env.g),
                     beta=float(self.env.beta), depth=self.depth, lid=lid,
@@ -560,6 +561,12 @@ def solve_bem_heading_grid(panels, w, rho, g, depth, lid, headings, beta):
     from raft_tpu.hydro.native_bem import solve_bem
 
     betas = np.sort(np.asarray(headings, dtype=float))
+    if not (betas[0] - 1e-9 <= beta <= betas[-1] + 1e-9):
+        # fail BEFORE the (expensive) panel solve, not after
+        raise ValueError(
+            f"current heading {beta:.3f} rad outside the requested grid "
+            f"[{betas[0]:.3f}, {betas[-1]:.3f}] — include it or setEnv first"
+        )
     A, B, F_all = solve_bem(panels, np.asarray(w), rho=rho, g=g,
                             beta=betas, depth=depth, lid=lid)
     bem_headings = (betas, F_all, A, B)

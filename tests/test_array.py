@@ -210,7 +210,6 @@ def test_array_heading_grid_restages_without_resolve(monkeypatch):
     """calcBEM(headings=[...]) on an array: setEnv(beta) re-stages the
     excitation by interpolation with NO second native solve, and staleness
     of the phased staging is honored."""
-    import raft_tpu.array as arr_mod
     from raft_tpu.hydro import native_bem
 
     design = load_design(OC3)

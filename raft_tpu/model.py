@@ -177,12 +177,15 @@ class Model:
                     self.depth, lid, headings, float(self.env.beta),
                 )
             else:
-                self._bem_headings = None      # a fresh single-heading solve
-                self.bem = solve_bem(          # supersedes any staged grid
+                self.bem = solve_bem(
                     panels, np.asarray(self.w),
                     rho=float(self.env.rho), g=float(self.env.g),
                     beta=float(self.env.beta), depth=self.depth, lid=lid,
                 )
+                # only after a SUCCESSFUL solve: the fresh single-heading
+                # result supersedes any staged grid (a failed solve must
+                # leave the staged state untouched)
+                self._bem_headings = None
         return self.bem
 
     def _heading_excitation(self, beta: float) -> np.ndarray:

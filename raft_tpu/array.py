@@ -34,7 +34,6 @@ from raft_tpu.hydro import node_kinematics, strip_added_mass, strip_excitation
 from raft_tpu.hydro.strip import StripKin
 from raft_tpu.mooring import (
     fairlead_tensions,
-    mooring_force,
     mooring_stiffness,
     parse_mooring,
     solve_equilibrium,

@@ -6,7 +6,6 @@ reference encodes at raft/raft.py:251-332, 873-900), written independently.
 """
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from raft_tpu.core import frustum
 

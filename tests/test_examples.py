@@ -5,7 +5,6 @@ import importlib.util
 import os
 import sys
 
-import numpy as np
 import pytest
 
 pytestmark = pytest.mark.slow

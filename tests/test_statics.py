@@ -7,7 +7,6 @@ code, which cannot run here (MoorPy absent) and contains documented bugs.
 import jax
 import jax.numpy as jnp
 import numpy as np
-import pytest
 
 from raft_tpu.build.members import build_member_set, build_rna
 from raft_tpu.core.types import Env, RNA

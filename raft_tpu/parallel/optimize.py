@@ -32,7 +32,13 @@ import jax.numpy as jnp
 
 from raft_tpu.core.cplx import Cx
 from raft_tpu.core.types import Env, MemberSet, RNA, WaveState
-from raft_tpu.parallel.sweep import forward_response, response_std, scale_diameters
+from raft_tpu.parallel.sweep import (
+    _bem_device_layout,
+    _stage_zeta,
+    forward_response,
+    response_std,
+    scale_diameters,
+)
 
 Array = jnp.ndarray
 
@@ -56,15 +62,53 @@ def nacelle_accel_std(Xi: Cx, wave: WaveState, rna: RNA) -> Array:
 
 
 def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
-               n_iter, remat):
-    """theta -> objective(Xi) through the reverse-differentiable pipeline."""
+               n_iter, remat, case_reduce=None):
+    """theta -> objective(Xi) through the reverse-differentiable pipeline.
+
+    ``wave`` may be a single sea state or a batched WaveState from
+    :func:`~raft_tpu.parallel.sweep.make_wave_states` (leading case axis on
+    every leaf): in the batched case each sea state gets its own drag-
+    linearization fixed point under ``vmap`` and the per-case objectives
+    reduce with ``case_reduce`` (default ``jnp.max`` — robust worst-case
+    design over the DLC table).
+
+    ``bem`` is detected by layout: :func:`~raft_tpu.parallel.sweep.
+    stage_bem` output (excitation already zeta-scaled to ONE sea state,
+    valid for a single wave only) or the raw host coefficient tuple
+    (A[6,6,nw], B[6,6,nw], F[6,nw]), which works for both — the
+    case-dependent zeta scaling then happens per case.
+    """
+    batched = wave.zeta.ndim == 2
+    if case_reduce is None:
+        case_reduce = jnp.max
+    staged = None
+    if bem is not None:
+        if isinstance(bem[2], Cx):            # stage_bem output
+            if batched:
+                raise ValueError(
+                    "batched sea states need the raw (A[6,6,nw], B[6,6,nw], "
+                    "F[6,nw]) coefficient tuple, not stage_bem output: the "
+                    "zeta scaling is per-case"
+                )
+        else:                                 # raw host layout: stage here
+            staged = _bem_device_layout(bem)
+            if not batched:
+                bem = _stage_zeta(staged, wave.zeta)
+                staged = None
+
+    def solve_one(m, wv):
+        b = _stage_zeta(staged, wv.zeta) if staged is not None else bem
+        out = forward_response(
+            members=m, rna=rna, env=env, wave=wv, C_moor=C_moor,
+            bem=b, n_iter=n_iter, method="scan", remat=remat,
+        )
+        return objective(out.Xi, wv, rna)
 
     def loss(theta):
-        out = forward_response(
-            members=apply_fn(members, theta), rna=rna, env=env, wave=wave,
-            C_moor=C_moor, bem=bem, n_iter=n_iter, method="scan", remat=remat,
-        )
-        return objective(out.Xi, wave, rna)
+        m = apply_fn(members, theta)
+        if batched:
+            return case_reduce(jax.vmap(lambda wv: solve_one(m, wv))(wave))
+        return solve_one(m, wave)
 
     return loss
 
@@ -93,8 +137,15 @@ def optimize_design(
     bem=None,
     n_iter: int = 25,
     remat: bool = False,
+    case_reduce=None,
 ) -> OptResult:
     """Minimize a response statistic over a geometry parameterization.
+
+    ``wave`` may be a batched WaveState (``make_wave_states``): the
+    objective then evaluates per sea-state case and reduces with
+    ``case_reduce`` (default max) — robust design over a DLC table; with
+    batched waves pass ``bem`` as the raw coefficient tuple (see
+    ``_make_loss``).
 
     ``objective(Xi, wave, rna) -> scalar`` is evaluated on the RAO solve of
     ``apply_fn(members, theta)``; the step is ``optax`` gradient descent
@@ -122,7 +173,7 @@ def optimize_design(
         optimizer = optax.adam(learning_rate)
 
     loss = _make_loss(members, rna, env, wave, C_moor, objective, apply_fn,
-                      bem, n_iter, remat)
+                      bem, n_iter, remat, case_reduce=case_reduce)
     val_grad = jax.jit(jax.value_and_grad(loss))
 
     theta = jnp.asarray(theta0, dtype=float)
@@ -161,9 +212,11 @@ def grad_nacelle_accel_std(
     bem=None,
     n_iter: int = 25,
     remat: bool = False,
+    case_reduce=None,
 ) -> Array:
     """d sigma_nacelle / d theta: the headline co-design derivative
-    (BASELINE.json configs[4]) as a single call."""
+    (BASELINE.json configs[4]) as a single call.  Batched ``wave`` -> the
+    derivative of the ``case_reduce`` (default worst-case) statistic."""
     loss = _make_loss(members, rna, env, wave, C_moor, nacelle_accel_std,
-                      apply_fn, bem, n_iter, remat)
+                      apply_fn, bem, n_iter, remat, case_reduce=case_reduce)
     return jax.grad(loss)(jnp.asarray(theta, dtype=float))

@@ -1,9 +1,10 @@
 """Design-load-case table evaluation: one design x many sea states.
 
 The WEIS outer-loop pattern the reference runs as N separate processes:
-here an [Hs, Tp] case table evaluates in ONE compiled vmapped call (the
-drag linearization is sea-state-dependent, so each case carries its own
-fixed point), optionally sharded over a device mesh.
+here an [Hs, Tp, heading] case table evaluates in ONE compiled vmapped
+call (the drag linearization is sea-state-dependent, so each case carries
+its own fixed point; each lane carries its own wave heading through the
+node kinematics), optionally sharded over a device mesh.
 """
 import os
 
@@ -19,11 +20,11 @@ from raft_tpu.parallel import make_wave_states, sweep_sea_states
 HERE = os.path.dirname(os.path.abspath(__file__))
 DESIGN = os.path.join(HERE, "..", "raft_tpu", "designs", "OC3spar.yaml")
 
-# a small IEC-flavoured scatter: (Hs [m], Tp [s])
+# a small IEC-flavoured scatter: (Hs [m], Tp [s], heading [rad])
 CASES = [
-    [1.5, 7.0], [2.5, 8.0], [3.5, 9.0],
-    [4.5, 10.0], [6.0, 11.0], [8.0, 12.0],
-    [10.0, 13.5], [12.0, 15.0],
+    [1.5, 7.0, 0.0], [2.5, 8.0, 0.0], [3.5, 9.0, 0.5],
+    [4.5, 10.0, 0.5], [6.0, 11.0, 1.0], [8.0, 12.0, 1.0],
+    [10.0, 13.5, 1.5], [12.0, 15.0, 1.5],
 ]
 
 
@@ -40,10 +41,12 @@ def main(nw: int = 100):
     C_moor = mooring_stiffness(moor, jnp.zeros(6))
 
     out = sweep_sea_states(members, rna, env, waves, C_moor)
-    print(f"{'Hs':>5} {'Tp':>5} | {'surge std':>9} {'heave std':>9} "
-          f"{'pitch std':>9} {'iters':>5}")
-    for (Hs, Tp), sig, it in zip(CASES, out["std dev"], out["iterations"]):
-        print(f"{Hs:5.1f} {Tp:5.1f} | {sig[0]:9.3f} {sig[2]:9.3f} "
+    print(f"{'Hs':>5} {'Tp':>5} {'beta':>5} | {'surge std':>9} "
+          f"{'sway std':>9} {'heave std':>9} {'pitch std':>9} {'iters':>5}")
+    for (Hs, Tp, beta), sig, it in zip(CASES, out["std dev"],
+                                       out["iterations"]):
+        print(f"{Hs:5.1f} {Tp:5.1f} {np.rad2deg(beta):4.0f}d | "
+              f"{sig[0]:9.3f} {sig[1]:9.3f} {sig[2]:9.3f} "
               f"{np.rad2deg(sig[4]):8.3f}d {int(it):5d}")
 
 

@@ -273,15 +273,36 @@ class ArrayModel:
 
     # --------------------------------------------------------------- eigen
 
-    def solveEigen(self):
-        """Block-diagonal 6N eigenproblem = N independent 6x6 problems."""
+    def solveEigen(self, n_pass: int = 3):
+        """Block-diagonal 6N eigenproblem = N independent 6x6 problems.
+
+        With BEM staged, the potMod members' strip added mass is gated out
+        of ``A_morison``, so each turbine's eigen assembly must fold in the
+        staged ``A_bem`` — evaluated at each mode's own natural frequency by
+        the same per-mode fixed point as ``Model.solveEigen``
+        (:func:`raft_tpu.solve.eigen_with_bem`; the shared hull means one
+        A(w) table serves all turbines, while M/C stay per-turbine).
+        """
         if self.statics is None:
             self.calcSystemProps()
         M_tot = self.statics.M_struc + self.A_morison
         C_tot = self.statics.C_struc + self.statics.C_hydro + self.C_moor0
         with phase("array-eigen"):
-            eig = jax.vmap(solve_eigen)(M_tot, C_tot)
-            est = jax.vmap(diagonal_estimates)(M_tot, C_tot)
+            if self.bem is None:
+                eig = jax.vmap(solve_eigen)(M_tot, C_tot)
+                est = jax.vmap(diagonal_estimates)(M_tot, C_tot)
+            else:
+                from raft_tpu.solve import eigen_with_bem
+
+                A_w = np.moveaxis(np.asarray(self.bem[0]), -1, 0)  # (nw,6,6)
+                wg = np.asarray(self.w)
+                per_t = [
+                    eigen_with_bem(M_tot[i], C_tot[i], A_w, wg, n_pass=n_pass)
+                    for i in range(self.nT)
+                ]
+                eig = jax.tree.map(lambda *xs: jnp.stack(xs),
+                                   *[e for e, _ in per_t])
+                est = np.stack([s for _, s in per_t])
         self.eigen = eig
         fns = np.asarray(eig.fns)                          # (nT, 6)
         self.results["eigen"] = {

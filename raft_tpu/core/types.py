@@ -197,3 +197,9 @@ class WaveState:
     k: Array               # (nw,) wave numbers [1/m]
     zeta: Array            # (nw,) wave amplitude spectrum sqrt(S(w)) [m] —
     #                        matches the reference convention raft/raft.py:1825
+    # wave heading [rad] — optional so existing (w, k, zeta) construction
+    # sites are untouched.  None means "use env.beta" (the single-case
+    # path); batched sea-state sweeps set it per case so a DLC table can
+    # vary heading alongside (Hs, Tp) (reference env surface carries beta,
+    # raft/runRAFT.py:68).
+    beta: Optional[Array] = struct.field(default=None)

@@ -298,8 +298,7 @@ class Model:
         """
         if self.statics is None:
             self.calcSystemProps()
-        from raft_tpu.solve import diagonal_estimates
-        import jax
+        from raft_tpu.solve import diagonal_estimates, eigen_with_bem
 
         M_base = self.statics.M_struc + self.A_morison
         C_tot = self.statics.C_struc + self.statics.C_hydro + self.C_moor0
@@ -310,39 +309,12 @@ class Model:
                 modes = np.asarray(self.eigen.modes)
                 est = np.asarray(diagonal_estimates(M_base, C_tot))
             else:
-                # per-mode A_bem(w_n) fixed point: mode i's frequency comes
-                # from the eigenproblem assembled with A interpolated at
-                # mode i's current natural frequency
                 A_w = np.moveaxis(np.asarray(self.bem[0]), -1, 0)  # (nw,6,6)
-                wg = np.asarray(self.w)
-                wns = np.full(6, wg[0])
-                solve6 = jax.jit(jax.vmap(solve_eigen, in_axes=(0, None)))
-                for _ in range(n_pass):
-                    A_modes = np.empty((6, 6, 6))
-                    for a in range(6):
-                        for b in range(6):
-                            A_modes[:, a, b] = np.interp(wns, wg, A_w[:, a, b])
-                    eigs = solve6(jnp.asarray(M_base + A_modes), C_tot)
-                    wns = np.asarray(eigs.wns)[np.arange(6), np.arange(6)]
-                # reduce the 6-assembly batch to one flat per-DOF result so
-                # self.eigen has the same shape with or without BEM staged
-                from raft_tpu.solve import EigenResult
-
-                self.eigen = EigenResult(
-                    fns=jnp.asarray(wns / (2.0 * np.pi)),
-                    wns=jnp.asarray(wns),
-                    modes=jnp.stack(
-                        [eigs.modes[i, :, i] for i in range(6)], axis=1
-                    ),
-                    order=jnp.stack([eigs.order[i, i] for i in range(6)]),
+                self.eigen, est = eigen_with_bem(
+                    M_base, C_tot, A_w, np.asarray(self.w), n_pass=n_pass
                 )
                 fns = np.asarray(self.eigen.fns)
                 modes = np.asarray(self.eigen.modes)
-                est = np.asarray(
-                    jax.vmap(diagonal_estimates, in_axes=(0, None))(
-                        jnp.asarray(M_base + A_modes), C_tot
-                    )
-                )[np.arange(6), np.arange(6)]
         self.results["eigen"] = {
             "frequencies": fns,
             "periods": np.asarray(1.0 / np.maximum(fns, 1e-12)),
@@ -375,18 +347,25 @@ class Model:
             F = F + Cx(jnp.asarray(zeta * Fb.real), jnp.asarray(zeta * Fb.imag))
         return LinearCoeffs(M=M, B=B, C=C, F=F)
 
-    def solveDynamics(self, nIter: int = 40, tol: float = 0.01, method="while"):
+    def solveDynamics(self, nIter: int = 40, tol: float = 0.01, method="while",
+                      history: bool = False):
         # nIter default is above the reference's 15 (raft/raft.py:1469): the
         # OC4 semi needs ~22 iterations from the 0.1 seed; the early-exit
         # driver makes the higher cap free for fast-converging cases
-        """RAO fixed-point solve (cf. Model.solveDynamics, raft/raft.py:1469)."""
+        """RAO fixed-point solve (cf. Model.solveDynamics, raft/raft.py:1469).
+
+        ``history=True`` records the per-iteration convergence error into
+        ``results["response"]["iteration error history"]`` — the diagnostic
+        the reference serves with per-iterate RAO plots
+        (raft/raft.py:1536-1539), for inspecting a non-converging case.
+        """
         if self.statics is None or self.kin is None:
             self.calcSystemProps()
         lin = self._linear_coeffs()
         with phase("rao-solve"):
             self.rao = solve_dynamics(
                 self.members, self.kin, self.wave, self.env, lin,
-                n_iter=nIter, tol=tol, method=method,
+                n_iter=nIter, tol=tol, method=method, history=history,
             )
         Xi = self.rao.Xi
         zeta = np.maximum(np.asarray(self.wave.zeta), 1e-12)
@@ -402,6 +381,10 @@ class Model:
             "converged": bool(self.rao.converged),
             "iterations": int(self.rao.n_iter),
         }
+        if self.rao.err_hist is not None:
+            self.results["response"]["iteration error history"] = np.asarray(
+                self.rao.err_hist
+            )
         return self
 
     # ------------------------------------------------------------- outputs
@@ -578,20 +561,21 @@ def solve_bem_heading_grid(panels, w, rho, g, depth, lid, headings, beta):
 
 def interp_heading_excitation(betas, F_all, beta: float) -> np.ndarray:
     """Excitation F[6,nw] at heading ``beta`` from a staged heading grid
-    (linear interpolation in heading, per component; shared by Model and
-    ArrayModel re-staging)."""
+    (linear interpolation in heading; shared by Model and ArrayModel
+    re-staging).  Runs per sea-state case inside ``setEnv``, so it is one
+    vectorized blend of the two bracketing heading slices, not a per-
+    (component, frequency) loop."""
+    betas = np.asarray(betas)
     if beta < betas[0] - 1e-9 or beta > betas[-1] + 1e-9:
         raise ValueError(
             f"heading {beta:.3f} rad outside staged grid "
             f"[{betas[0]:.3f}, {betas[-1]:.3f}]"
         )
-    nw = F_all.shape[-1]
-    F = np.empty((6, nw), dtype=complex)
-    for i in range(6):
-        for iw in range(nw):
-            F[i, iw] = np.interp(beta, betas, F_all[:, i, iw].real) + 1j * \
-                np.interp(beta, betas, F_all[:, i, iw].imag)
-    return F
+    if len(betas) == 1:
+        return np.asarray(F_all[0])
+    j = int(np.clip(np.searchsorted(betas, beta), 1, len(betas) - 1))
+    t = float(np.clip((beta - betas[j - 1]) / (betas[j] - betas[j - 1]), 0.0, 1.0))
+    return (1.0 - t) * np.asarray(F_all[j - 1]) + t * np.asarray(F_all[j])
 
 
 def load_design(fname: str) -> dict:

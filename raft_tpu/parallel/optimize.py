@@ -36,7 +36,6 @@ from raft_tpu.parallel.sweep import (
     _bem_device_layout,
     _stage_zeta,
     forward_response,
-    response_std,
     scale_diameters,
 )
 

@@ -92,6 +92,9 @@ def forward_response(
 ):
     """Design -> RAO solve: the pure forward pipeline (statics through Xi).
 
+    A ``wave.beta`` (set per case by :func:`make_wave_states` 3-column
+    rows) overrides ``env.beta`` for the node kinematics, so a
+    heading-carrying WaveState means the same thing everywhere.
     Strip-theory path by default; pass ``bem`` (the output of
     :func:`stage_bem`) to add potential-flow coefficients — the potMod
     members are then gated out of the Morison added mass/excitation exactly
@@ -103,6 +106,8 @@ def forward_response(
     for gradient work.
     Returns the :class:`~raft_tpu.solve.RAOResult`.
     """
+    if wave.beta is not None:
+        env = env.replace(beta=wave.beta)
     exclude = bem is not None
     stat = assemble_statics(members, rna, env)
     kin = node_kinematics(members, wave, env)
@@ -149,6 +154,8 @@ def _local_freq_solve(members, rna, env, wave_l, C_moor, bem_l, exclude,
     """RAO solve on this device's frequency shard (collectives over ``axis``
     complete the drag linearization's spectral moment and the convergence
     check — see solve_dynamics)."""
+    if wave_l.beta is not None:
+        env = env.replace(beta=wave_l.beta)
     stat = assemble_statics(members, rna, env)
     kin = node_kinematics(members, wave_l, env)
     A = strip_added_mass(members, env, exclude_potmod=exclude)
@@ -200,7 +207,9 @@ def forward_response_freq_sharded(
         raise ValueError(f"nw={nw} not divisible by {n_dev} devices")
     exclude = bem is not None
     P_w = P(axis)
-    wave_specs = WaveState(w=P_w, k=P_w, zeta=P_w)
+    # a heading on the wave is a replicated scalar, not a sharded axis
+    wave_specs = WaveState(w=P_w, k=P_w, zeta=P_w,
+                           beta=None if wave.beta is None else P())
     bem_specs = (P(axis), P(axis), Cx(P(axis), P(axis))) if bem is not None else None
 
     from raft_tpu.solve.dynamics import RAOResult
@@ -251,10 +260,22 @@ def forward_response_dp_sp(
     over the 2-D mesh with an inner ``vmap`` over the local design lanes.
 
     Requires ``len(thetas)`` divisible by the design-axis size and
-    ``len(wave.w)`` divisible by the frequency-axis size.  Returns the
-    RAOResult with a leading design-batch axis; agrees with a vmapped
-    :func:`forward_response` up to reduction order.
+    ``len(wave.w)`` divisible by the frequency-axis size.  ``bem`` must be
+    the STAGED tuple from :func:`stage_bem` — (A[nw,6,6], B[nw,6,6],
+    F :class:`Cx` [nw,6], excitation already zeta-scaled) — NOT the raw
+    host layout (A[6,6,nw], B, F complex) that the batched sea-state APIs
+    take (those re-stage per case; here one sea state is fixed, so staging
+    happens once up front).  Returns the RAOResult with a leading
+    design-batch axis; agrees with a vmapped :func:`forward_response` up to
+    reduction order.
     """
+    if bem is not None and not isinstance(bem[2], Cx):
+        raise ValueError(
+            "forward_response_dp_sp expects the STAGED bem tuple from "
+            "stage_bem(bem_raw, wave) — (A[nw,6,6], B[nw,6,6], F Cx[nw,6]) "
+            f"— got F of type {type(bem[2]).__name__}; pass the raw "
+            "(A[6,6,nw], B, F complex) host tuple through stage_bem first"
+        )
     shard_map, kw = _shard_map()
     if mesh.devices.ndim != 2:
         raise ValueError(
@@ -271,7 +292,9 @@ def forward_response_dp_sp(
         raise ValueError(f"nw={nw} not divisible by {n_f} (axis {axis_f!r})")
     exclude = bem is not None
     P_w = P(axis_f)
-    wave_specs = WaveState(w=P_w, k=P_w, zeta=P_w)
+    # a heading on the wave is a replicated scalar, not a sharded axis
+    wave_specs = WaveState(w=P_w, k=P_w, zeta=P_w,
+                           beta=None if wave.beta is None else P())
     bem_specs = (P(axis_f), P(axis_f), Cx(P(axis_f), P(axis_f))) if bem is not None else None
 
     from raft_tpu.solve.dynamics import RAOResult
@@ -302,23 +325,34 @@ def forward_response_dp_sp(
 
 
 def make_wave_states(w, cases, depth, g: float = 9.81) -> WaveState:
-    """Stack (Hs, Tp) sea states into one batched WaveState.
+    """Stack sea-state rows into one batched WaveState.
 
-    ``cases``: (B, 2) array-like of [Hs, Tp] rows — e.g. a design-load-case
-    table.  Returns a WaveState whose ``zeta`` has a leading case axis
-    (``w``/``k`` are broadcast), ready for :func:`sweep_sea_states`.
+    ``cases``: (B, 2) array-like of [Hs, Tp] rows or (B, 3) of
+    [Hs, Tp, beta] rows (heading in rad) — e.g. a design-load-case table
+    (the reference's env surface carries beta too, raft/runRAFT.py:68).
+    Returns a WaveState whose ``zeta`` (and ``beta``, for 3-column rows)
+    has a leading case axis (``w``/``k`` are broadcast), ready for
+    :func:`sweep_sea_states`.
     """
     w = jnp.asarray(w, dtype=float)
-    cases = np.asarray(cases, dtype=float).reshape(-1, 2)
+    cases = np.asarray(cases, dtype=float)
+    if cases.ndim == 1:              # one flat row: [Hs, Tp] or [Hs, Tp, beta]
+        cases = cases[None, :]
+    if cases.ndim != 2 or cases.shape[-1] not in (2, 3):
+        raise ValueError(
+            f"cases rows must be [Hs, Tp] or [Hs, Tp, beta]; got shape "
+            f"{cases.shape}"
+        )
     from raft_tpu.core.waves import jonswap, wave_number
 
     k = wave_number(w, depth, g=g)
-    zeta = jnp.stack([jnp.sqrt(jonswap(w, Hs, Tp)) for Hs, Tp in cases])
+    zeta = jnp.stack([jnp.sqrt(jonswap(w, Hs, Tp)) for Hs, Tp in cases[:, :2]])
     B = zeta.shape[0]
     return WaveState(
         w=jnp.broadcast_to(w, (B,) + w.shape),
         k=jnp.broadcast_to(k, (B,) + k.shape),
         zeta=zeta,
+        beta=jnp.asarray(cases[:, 2]) if cases.shape[-1] == 3 else None,
     )
 
 
@@ -341,39 +375,80 @@ def sweep_sea_states(
     must share one uniform frequency grid (checked; the response integral
     uses a single dw).  The wave kinematics, excitation, and the whole
     drag-linearized fixed point (the drag linearization is sea-state-
-    dependent) are vmapped over the case axis.  Note the staged ``bem``
+    dependent) are vmapped over the case axis.  With ``waves.beta`` set
+    (3-column DLC rows), each case lane additionally carries its own wave
+    heading through the node kinematics.  Note the staged ``bem``
     excitation is zeta-scaled, so it must be staged per case — pass the raw
     coefficient tuple and this function stages it under the vmap.
+
+    ``bem``: either the heading-independent raw tuple (A[6,6,nw], B, F[6,nw]
+    complex), or — required when headings vary across cases — the staged
+    heading GRID (betas_grid, F_all[nb,6,nw], A[6,6,nw], B[6,6,nw]) that
+    ``Model.calcBEM(headings=...)`` stages (``model._bem_headings``): each
+    case's excitation is interpolated to its heading on the host before the
+    compiled sweep (the solver side of the grid is
+    :func:`raft_tpu.model.solve_bem_heading_grid`, the capability of the
+    reference's HAMS heading grids, hams/pyhams.py:196-289).
     """
     w_rows = np.asarray(waves.w)
     if not (w_rows == w_rows[0]).all():
         raise ValueError("sweep_sea_states requires one shared frequency "
                          "grid across cases (make_wave_states builds one)")
+    B = int(waves.zeta.shape[0])
+    betas_case = None if waves.beta is None else np.asarray(waves.beta)
 
     # pre-convert the coefficient layout once on host so the vmapped body
-    # is pure jnp: the zeta scaling (the only sea-state-dependent part of
-    # the staging) happens per case lane
-    staged = _bem_device_layout(bem) if bem is not None else None
+    # is pure jnp: per-case excitation (heading interpolation) and the zeta
+    # scaling (the only sea-state-dependent parts) happen per case lane
+    staged = None
+    if bem is not None:
+        if len(bem) == 4:                    # staged heading grid
+            from raft_tpu.model import interp_heading_excitation
 
-    def one(wave):
-        b = _stage_zeta(staged, wave.zeta) if staged is not None else None
+            bgrid, F_all, A_h, B_h = bem
+            betas_eval = (betas_case if betas_case is not None
+                          else np.full(B, float(env.beta)))
+            F_rows = np.stack([
+                interp_heading_excitation(np.asarray(bgrid), F_all, float(b))
+                for b in betas_eval
+            ])                               # (B,6,nw) complex
+        elif betas_case is not None:
+            raise ValueError(
+                "cases vary the wave heading but bem is a single-heading "
+                "(A, B, F) tuple; pass the staged heading grid "
+                "(betas, F_all, A, B) from Model.calcBEM(headings=...) so "
+                "each case gets its own BEM excitation"
+            )
+        else:
+            A_h, B_h, F_h = bem
+            F_rows = np.broadcast_to(np.asarray(F_h), (B,) + np.shape(F_h))
+        A_dev, B_dev, _, _ = _bem_device_layout((A_h, B_h, F_rows[0]))
+        Fb = np.moveaxis(np.asarray(F_rows), -1, 1)          # (B,nw,6)
+        staged = (A_dev, B_dev, jnp.asarray(Fb.real), jnp.asarray(Fb.imag))
+
+    def one(wave, F_re, F_im):
+        # forward_response folds the lane's wave.beta into env itself
+        b = (_stage_zeta((staged[0], staged[1], F_re, F_im), wave.zeta)
+             if staged is not None else None)
         out = forward_response(members, rna, env, wave, C_moor, bem=b,
                                n_iter=n_iter)
         return out.Xi.abs2(), out.n_iter
 
+    # dummy per-case excitation keeps one vmap signature when bem is None
+    F_re = staged[2] if staged is not None else jnp.zeros((B, 1))
+    F_im = staged[3] if staged is not None else jnp.zeros((B, 1))
     if mesh is not None:
         if mesh.devices.ndim != 1:
             raise ValueError(f"sweep_sea_states expects a 1-D mesh; got "
                              f"shape {mesh.devices.shape}")
         n_dev = int(mesh.devices.shape[0])
-        B = int(waves.zeta.shape[0])
         if B % n_dev != 0:
             raise ValueError(f"{B} sea states not divisible by {n_dev} devices")
         sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
-        fn = jax.jit(jax.vmap(one), in_shardings=sharding)
+        fn = jax.jit(jax.vmap(one), in_shardings=(sharding,) * 3)
     else:
         fn = jax.jit(jax.vmap(one))
-    abs2, iters = fn(waves)
+    abs2, iters = fn(waves, F_re, F_im)
     sigma = response_std(abs2, waves.w[0])
     return {
         "std dev": np.asarray(sigma),

@@ -9,5 +9,6 @@ from raft_tpu.solve.eigen import (  # noqa: F401
     EigenResult,
     diagonal_estimates,
     dominance_order,
+    eigen_with_bem,
     solve_eigen,
 )

@@ -58,6 +58,11 @@ class RAOResult:
     converged: Array  # () bool
     B_drag: Array     # (6,6) linearized drag damping at the solution
     F_drag: Cx        # (nw,6) drag excitation at the solution
+    # (n_iter,) per-iteration convergence error when solve_dynamics ran with
+    # history=True (NaN past the exit iteration); None otherwise.  The
+    # convergence-inspection capability of the reference's per-iterate RAO
+    # plots (raft/raft.py:1536-1539) as data instead of figures.
+    err_hist: Array | None = None
 
 
 def impedance(w: Array, M: Array, B: Array, C: Array) -> Cx:
@@ -80,7 +85,7 @@ def _error(Xi: Cx, Xi_last: Cx, tol: float) -> Array:
 
 
 @partial(jax.jit, static_argnames=("n_iter", "tol", "relax", "method",
-                                   "axis_name", "remat"))
+                                   "axis_name", "remat", "history"))
 def solve_dynamics(
     m: MemberSet,
     kin: StripKin,
@@ -93,6 +98,7 @@ def solve_dynamics(
     method: str = "scan",
     axis_name: str | None = None,
     remat: bool = False,
+    history: bool = False,
 ) -> RAOResult:
     """Solve Xi(w) by fixed-point drag linearization (raft/raft.py:1469-1552).
 
@@ -117,6 +123,13 @@ def solve_dynamics(
     spectral moment completes with a ``psum`` and the convergence error
     with a ``pmax`` over that axis, so every shard takes the same number
     of iterations and reproduces the unsharded fixed point exactly.
+
+    ``history=True`` additionally records the convergence error of every
+    iteration into ``RAOResult.err_hist`` (shape ``(n_iter,)``, NaN past
+    the exit iteration) — the diagnostic for a non-converging design lane
+    that the reference serves with per-iterate RAO plots
+    (raft/raft.py:1536-1539).  Static flag, so the default hot path carries
+    no history buffer.
     """
     nw = wave.w.shape[-1]
     dtype = lin.C.dtype
@@ -136,23 +149,27 @@ def solve_dynamics(
 
     def advance(carry):
         """One fixed-point step with post-convergence freeze."""
-        Xi_last, Xi_out, done, count = carry
+        Xi_last, Xi_out, done, count, hist = carry
         Xi, err = step(Xi_last)
         conv = err < tol
         Xi_out = cplx.where(done, Xi_out, Xi)
         Xi_next = cplx.where(done, Xi_last, Xi_last * (1.0 - relax) + Xi * relax)
+        if hist is not None:
+            # frozen lanes keep their buffer; live lanes log this iterate
+            hist = hist.at[count].set(jnp.where(done, hist[count], err))
         count = count + (~done).astype(count.dtype)
-        return Xi_next, Xi_out, done | conv, count
+        return Xi_next, Xi_out, done | conv, count, hist
 
-    init = (Xi0, Xi0, jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32))
+    hist0 = jnp.full((n_iter,), jnp.nan, dtype=dtype) if history else None
+    init = (Xi0, Xi0, jnp.asarray(False), jnp.asarray(0, dtype=jnp.int32), hist0)
 
     if method == "while":
-        _, Xi_out, done, count = jax.lax.while_loop(
+        _, Xi_out, done, count, hist = jax.lax.while_loop(
             lambda c: (~c[2]) & (c[3] < n_iter), advance, init
         )
     elif method == "scan":
         step_fn = jax.checkpoint(advance) if remat else advance
-        (_, Xi_out, done, count), _ = jax.lax.scan(
+        (_, Xi_out, done, count, hist), _ = jax.lax.scan(
             lambda c, _: (step_fn(c), None), init, None, length=n_iter
         )
     else:
@@ -160,4 +177,5 @@ def solve_dynamics(
 
     B_drag, F_drag = linearized_drag(m, kin, Xi_out, wave, env,
                                      axis_name=axis_name)
-    return RAOResult(Xi=Xi_out, n_iter=count, converged=done, B_drag=B_drag, F_drag=F_drag)
+    return RAOResult(Xi=Xi_out, n_iter=count, converged=done, B_drag=B_drag,
+                     F_drag=F_drag, err_hist=hist)

@@ -97,6 +97,50 @@ def diagonal_estimates(M_tot: Array, C_tot: Array) -> Array:
     return jnp.sqrt(jnp.stack(w2, axis=-1)) / _TWO_PI
 
 
+def eigen_with_bem(M_base, C_tot, A_w, w_grid, n_pass: int = 3):
+    """Eigen solve with frequency-dependent BEM added mass, evaluated *at
+    each mode's own natural frequency* by a small host-driven fixed point:
+    solve with A(w_n) interpolated per mode, update w_n, repeat ``n_pass``
+    times (converges in 2-3 passes — A(w) varies slowly near the rigid-body
+    modes).  The reference cannot do this: its BEM arrays in the eigen
+    assembly are always zero (raft/raft.py:1380,1797-1800).
+
+    ``M_base``: (6,6) structural + Morison mass (potMod members excluded);
+    ``A_w``: (nw,6,6) frequency-leading BEM added mass on the host;
+    ``w_grid``: (nw,) the BEM frequency grid [rad/s].
+    Returns ``(EigenResult with flat per-DOF fields, estimates[6] in Hz)``
+    — shared by ``Model.solveEigen`` and ``ArrayModel.solveEigen``.
+    """
+    import numpy as np
+
+    A_w = np.asarray(A_w)
+    w_grid = np.asarray(w_grid)
+    wns = np.full(6, w_grid[0])
+    solve6 = jax.jit(jax.vmap(solve_eigen, in_axes=(0, None)))
+    for _ in range(n_pass):
+        # A_modes[i] = A(w_n of mode i): one eigen assembly per mode
+        A_modes = np.empty((6, 6, 6))
+        for a in range(6):
+            for b in range(6):
+                A_modes[:, a, b] = np.interp(wns, w_grid, A_w[:, a, b])
+        eigs = solve6(jnp.asarray(M_base + A_modes), C_tot)
+        wns = np.asarray(eigs.wns)[np.arange(6), np.arange(6)]
+    # reduce the 6-assembly batch to one flat per-DOF result so the caller
+    # sees the same shape with or without BEM staged
+    result = EigenResult(
+        fns=jnp.asarray(wns) / _TWO_PI,
+        wns=jnp.asarray(wns),
+        modes=jnp.stack([eigs.modes[i, :, i] for i in range(6)], axis=1),
+        order=jnp.stack([eigs.order[i, i] for i in range(6)]),
+    )
+    est = np.asarray(
+        jax.vmap(diagonal_estimates, in_axes=(0, None))(
+            jnp.asarray(M_base + A_modes), C_tot
+        )
+    )[np.arange(6), np.arange(6)]
+    return result, est
+
+
 @partial(jax.jit, static_argnames=("sweeps",))
 def solve_eigen(M_tot: Array, C_tot: Array, sweeps: int = 12) -> EigenResult:
     """Natural frequencies of the undamped 6-DOF system.

@@ -79,6 +79,11 @@ def summary() -> str:
     return "\n".join(lines)
 
 
+def totals() -> dict:
+    """Accumulated {phase: seconds} — e.g. for embedding in a bench JSON."""
+    return dict(_totals)
+
+
 def reset():
     _totals.clear()
     _counts.clear()

@@ -159,6 +159,44 @@ def test_array_with_staged_bem_matches_single():
     )
 
 
+def test_array_eigen_with_staged_bem_matches_single():
+    """With BEM staged the potMod strip added mass is gated out of
+    A_morison, so the array eigen assembly must fold in the staged
+    A_bem(w_n) per mode exactly as the single model does."""
+    design = load_design(OC3)
+    nw = len(W)
+    A = np.zeros((6, 6, nw))
+    for i in range(6):
+        A[i, i] = 5e6 * (1e3 if i >= 3 else 1.0) / (1 + W**2)
+    B = np.zeros((6, 6, nw))
+    F = np.zeros((6, nw), dtype=complex)
+
+    m1 = Model(design, w=W, BEM=(A, B, F))
+    m1.setEnv(Hs=8.0, Tp=12.0)
+    m1.calcSystemProps()
+    m1.solveEigen()
+    f1 = m1.results["eigen"]["frequencies"]
+
+    a = Model(design, w=W, nTurbines=2, BEM=(A, B, F))
+    a.setEnv(Hs=8.0, Tp=12.0)
+    a.calcSystemProps()
+    a.solveEigen()
+    fa = a.results["eigen"]["frequencies"]
+    assert fa.shape == (2, 6)
+    np.testing.assert_allclose(fa[0], f1, rtol=1e-7)
+    np.testing.assert_allclose(fa[1], f1, rtol=1e-7)
+    assert a.results["eigen"]["estimates"].shape == (2, 6)
+
+    # and the staged added mass really enters the assembly (not a no-op):
+    # the frequency-dependent A shifts the modes vs the Morison-only solve
+    m0 = Model(design, w=W)
+    m0.setEnv(Hs=8.0, Tp=12.0)
+    m0.calcSystemProps()
+    m0.solveEigen()
+    f0 = m0.results["eigen"]["frequencies"]
+    assert np.abs(f1 - f0).max() / np.abs(f0).max() > 1e-3
+
+
 def test_mixed_design_array_with_bem_raises():
     d3, d4 = load_design(OC3), load_design(OC4)
     with pytest.raises(NotImplementedError):

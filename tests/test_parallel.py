@@ -92,6 +92,84 @@ def test_sea_state_sweep_with_bem_matches_staged_single():
         np.testing.assert_allclose(out["std dev"][i], sig, rtol=1e-12)
 
 
+def test_sweep_sea_states_heading_axis():
+    """(Hs, Tp, beta) DLC rows: each case lane carries its own wave heading
+    through the node kinematics, pinned against per-case single solves."""
+    import __graft_entry__ as ge
+    from raft_tpu.core.types import WaveState
+    from raft_tpu.parallel import (
+        forward_response, make_wave_states, response_std, sweep_sea_states,
+    )
+
+    design, members, rna, env, wave = ge._base(nw=12)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    cases = [[6.0, 10.0, 0.0], [6.0, 10.0, 0.7], [8.0, 12.0, 1.3]]
+    waves = make_wave_states(np.asarray(wave.w), cases, float(env.depth))
+    assert waves.beta is not None and waves.beta.shape == (3,)
+    out = sweep_sea_states(members, rna, env, waves, C_moor)
+    # cases 0 and 1 share (Hs, Tp): only the heading separates them
+    assert np.abs(out["std dev"][0] - out["std dev"][1]).max() > 1e-9
+    for i, (Hs, Tp, beta) in enumerate(cases):
+        wi = WaveState(w=waves.w[i], k=waves.k[i], zeta=waves.zeta[i])
+        ref = forward_response(members, rna, env.replace(beta=beta), wi, C_moor)
+        sig = np.asarray(response_std(ref.Xi.abs2(), wi.w))
+        np.testing.assert_allclose(out["std dev"][i], sig, rtol=1e-12, atol=1e-14)
+    # a heading-carrying WaveState means the same thing OUTSIDE the sweep:
+    # forward_response folds wave.beta into env rather than ignoring it
+    w1 = WaveState(w=waves.w[1], k=waves.k[1], zeta=waves.zeta[1],
+                   beta=waves.beta[1])
+    direct = forward_response(members, rna, env, w1, C_moor)
+    via_env = forward_response(
+        members, rna, env.replace(beta=0.7),
+        WaveState(w=waves.w[1], k=waves.k[1], zeta=waves.zeta[1]), C_moor,
+    )
+    np.testing.assert_allclose(np.asarray(direct.Xi.re),
+                               np.asarray(via_env.Xi.re), rtol=1e-12)
+
+
+def test_sweep_sea_states_heading_axis_with_bem_grid():
+    """Heading-varying cases consume a staged BEM heading grid: each case's
+    excitation is interpolated to its own heading; a single-heading bem
+    tuple under varying headings is rejected."""
+    import __graft_entry__ as ge
+    from raft_tpu.core.types import WaveState
+    from raft_tpu.model import interp_heading_excitation
+    from raft_tpu.parallel import (
+        forward_response, make_wave_states, response_std, stage_bem,
+        sweep_sea_states,
+    )
+
+    design, members, rna, env, wave = ge._base(nw=12)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    nw = 12
+    rng = np.random.default_rng(1)
+    A = np.tile(np.eye(6)[:, :, None] * 5e6, (1, 1, nw))
+    B = np.tile(np.eye(6)[:, :, None] * 1e5, (1, 1, nw))
+    bgrid = np.array([0.0, 1.0])
+    F_all = (rng.normal(size=(2, 6, nw))
+             + 1j * rng.normal(size=(2, 6, nw))) * 1e5
+    cases = [[6.0, 10.0, 0.25], [8.0, 12.0, 0.75]]
+    waves = make_wave_states(np.asarray(wave.w), cases, float(env.depth))
+    out = sweep_sea_states(members, rna, env, waves, C_moor,
+                           bem=(bgrid, F_all, A, B))
+    for i, (Hs, Tp, beta) in enumerate(cases):
+        F_i = interp_heading_excitation(bgrid, F_all, beta)
+        wi = WaveState(w=waves.w[i], k=waves.k[i], zeta=waves.zeta[i])
+        ref = forward_response(members, rna, env.replace(beta=beta), wi,
+                               C_moor, bem=stage_bem((A, B, F_i), wi))
+        sig = np.asarray(response_std(ref.Xi.abs2(), wi.w))
+        np.testing.assert_allclose(out["std dev"][i], sig, rtol=1e-12)
+    with pytest.raises(ValueError, match="heading"):
+        sweep_sea_states(members, rna, env, waves, C_moor,
+                         bem=(A, B, F_all[0]))
+
+
 @pytest.mark.slow
 def test_2d_mesh_dp_sp_matches_unsharded():
     """Composed design x frequency parallelism: a (2, 4) mesh — design

@@ -132,6 +132,28 @@ def test_while_matches_scan():
     assert int(a.n_iter) == int(b.n_iter)
 
 
+def test_iteration_error_history():
+    """history=True records each iteration's convergence error (NaN past the
+    exit iteration) in both drivers without changing the solution; the
+    default path carries no buffer at all."""
+    m, kin, wave, env, lin = setup()
+    base = solve_dynamics(m, kin, wave, env, lin, method="scan")
+    assert base.err_hist is None
+    for method in ("scan", "while"):
+        out = solve_dynamics(m, kin, wave, env, lin, method=method,
+                             history=True)
+        h = np.asarray(out.err_hist)
+        n = int(out.n_iter)
+        assert h.shape == (15,) and 0 < n <= 15
+        assert np.isfinite(h[:n]).all()
+        assert np.isnan(h[n:]).all()
+        assert h[n - 1] < 0.01          # exit iterate passed the tolerance
+        np.testing.assert_allclose(
+            np.asarray(out.Xi.to_complex()),
+            np.asarray(base.Xi.to_complex()), rtol=1e-9,
+        )
+
+
 @pytest.mark.slow
 def test_vmap_over_seastates_matches_loop():
     m, kin, wave, env, lin = setup()

@@ -92,14 +92,17 @@ def _flops_per_call(compiled):
         return None
 
 
-def _volturn_setup(nw: int = 200, nw_bem: int = 24):
+def _volturn_setup(nw: int = 200, nw_bem: int = 48):
     """VolturnUS-S members/env/wave/mooring + staged BEM coefficients.
 
     BEM coefficients are solved on a coarse frequency grid by the native
     panel solver (cached content-addressed) and interpolated to the model
     grid — the reference's own staging pattern (its Capytaine fixture holds
     28 frequencies that get interpolated to the design grid,
-    tests/test_capytaine_integration.py:36-78).  The staged coefficients
+    tests/test_capytaine_integration.py:36-78).  ``nw_bem=48`` is the
+    measured-convergence choice: vs a 2x denser solve the staged response
+    error is <1% (a 24-point grid leaves 3-5%) —
+    tests/test_bem_staging.py pins this.  The staged coefficients
     are those of the nominal hull, applied across the +-10% geometry
     variants: the standard linearized-sweep approximation (re-running the
     panel solver per variant is exactly what staging exists to avoid).

@@ -347,14 +347,15 @@ class ArrayModel:
     # ------------------------------------------------------------ dynamics
 
     def solveDynamics(self, nIter: int = 40, tol: float = 0.01, method="while",
-                      mesh=None):
+                      mesh=None, history: bool = False):
         """RAO solve for every turbine in one vmapped call.
 
         ``mesh``: optional 1-D ``jax.sharding.Mesh`` — the turbine axis is
         pure data parallelism, so a wind farm shards across TPU chips by
         placing each turbine's stacked inputs on its device (nT must be a
         multiple of the mesh size); XLA keeps the whole solve local per
-        device with no collectives."""
+        device with no collectives.  ``history=True`` records each
+        turbine's per-iteration convergence error (cf. Model.solveDynamics)."""
         if mesh is not None:
             n_dev = int(np.prod(mesh.devices.shape))
             if self.nT % n_dev != 0:
@@ -384,7 +385,8 @@ class ArrayModel:
                 M=M, B=B, C=C_struc + C_hydro + C_moor, F=F,
             )
             return solve_dynamics(members, kin, wave, env, lin,
-                                  n_iter=nIter, tol=tol, method=method)
+                                  n_iter=nIter, tol=tol, method=method,
+                                  history=history)
 
         F_bem_t = (
             staged[2] if staged is not None
@@ -418,6 +420,10 @@ class ArrayModel:
             "converged": np.asarray(self.rao.converged),
             "iterations": np.asarray(self.rao.n_iter),
         }
+        if self.rao.err_hist is not None:
+            self.results["response"]["iteration error history"] = np.asarray(
+                self.rao.err_hist                            # (nT, nIter)
+            )
         return self
 
     def print_report(self):

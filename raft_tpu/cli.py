@@ -46,24 +46,38 @@ def _add_env_args(p):
     p.add_argument("--dw", type=float, default=0.05)
 
 
-def _build_pipeline_inputs(args):
-    """Shared sweep/optimize setup: design -> (members, rna, env, wave, C_moor).
+def _build_pipeline_inputs(args, headings=None):
+    """Shared sweep/optimize/dlc setup: design ->
+    (members, rna, env, wave, C_moor, model).
 
     Goes through the Model facade so the staged inputs match the analyze
     path exactly: thrust applied, mean equilibrium solved, mooring
     stiffness linearized about that offset (model.py calcMooringAndOffsets)
-    — the nominal design's C_moor is then staged across all variants."""
+    — the nominal design's C_moor is then staged across all variants.
+    With ``args.bem`` set the native BEM solve runs too; ``headings``
+    (rad) stages a heading grid in that one solve (model._bem_headings)."""
     from raft_tpu.model import Model, load_design
 
     design = load_design(_design_path(args.design))
     thrust = args.thrust
     if thrust is None:
         thrust = float(design.get("turbine", {}).get("Fthrust", 0.0))
-    model = Model(design, w=np.arange(args.wmin, args.wmax, args.dw))
-    model.setEnv(Hs=args.hs, Tp=args.tp, Fthrust=thrust)
+    use_bem = bool(getattr(args, "bem", False))
+    model = Model(design, w=np.arange(args.wmin, args.wmax, args.dw),
+                  BEM="native" if use_bem else None)
+    env_kw = {}
+    if headings is not None:
+        # env.beta must sit inside the staged grid (calcBEM re-stages the
+        # current heading's excitation by interpolation)
+        env_kw["beta"] = float(np.asarray(headings, dtype=float)[0])
+    model.setEnv(Hs=args.hs, Tp=args.tp, Fthrust=thrust, **env_kw)
+    if use_bem and headings is not None:
+        model.calcBEM(dz_max=getattr(args, "dz_max", 3.0),
+                      da_max=getattr(args, "da_max", 2.0),
+                      headings=np.asarray(headings, dtype=float))
     model.calcSystemProps()
     model.calcMooringAndOffsets()
-    return model.members, model.rna, model.env, model.wave, model.C_moor
+    return model.members, model.rna, model.env, model.wave, model.C_moor, model
 
 
 def _param_fn(members, names):
@@ -110,7 +124,7 @@ def main_sweep(argv):
 
     from raft_tpu.parallel import sweep
 
-    members, rna, env, wave, C_moor = _build_pipeline_inputs(args)
+    members, rna, env, wave, C_moor, _ = _build_pipeline_inputs(args)
     apply = _param_fn(members, [args.param])
     thetas = jnp.linspace(args.lo, args.hi, args.n)
     out = sweep(members, rna, env, wave, C_moor, thetas, apply_fn=apply)
@@ -122,6 +136,89 @@ def main_sweep(argv):
     }
     print(json.dumps(rows))
     return rows
+
+
+def main_dlc(argv):
+    p = argparse.ArgumentParser(
+        prog="raft-tpu dlc",
+        description="design-load-case table: one design x many sea states "
+                    "(Hs, Tp[, heading]) in one compiled batched solve",
+    )
+    p.add_argument("design")
+    p.add_argument("--cases", required=True,
+                   help="CSV file of rows 'Hs,Tp[,beta_deg]' (lines starting "
+                        "with # and non-numeric header lines are skipped)")
+    p.add_argument("--bem", action="store_true",
+                   help="run the native BEM solver once, staging a heading "
+                        "grid over the table's unique headings (per-case "
+                        "excitation interpolated to its own heading)")
+    p.add_argument("--thrust", type=float, default=None,
+                   help="rotor thrust [N] (default: design Fthrust)")
+    p.add_argument("--dz-max", type=float, default=3.0,
+                   help="BEM mesh: max panel height [m]")
+    p.add_argument("--da-max", type=float, default=2.0,
+                   help="BEM mesh: max panel azimuthal width [m]")
+    p.add_argument("--wmin", type=float, default=0.05)
+    p.add_argument("--wmax", type=float, default=3.0)
+    p.add_argument("--dw", type=float, default=0.05)
+    args = p.parse_args(argv)
+
+    rows = []
+    with open(args.cases) as f:
+        for lineno, ln in enumerate(f, 1):
+            ln = ln.strip()
+            if not ln or ln.startswith("#"):
+                continue
+            try:
+                rows.append([float(x) for x in ln.replace(",", " ").split()])
+            except ValueError:
+                if lineno == 1:           # a spreadsheet header line
+                    continue
+                raise SystemExit(
+                    f"{args.cases}:{lineno}: non-numeric case row {ln!r} "
+                    f"(rows are 'Hs,Tp' or 'Hs,Tp,beta_deg')"
+                )
+    ncol = {len(r) for r in rows}
+    if not rows or ncol not in ({2}, {3}):
+        raise SystemExit(
+            f"--cases rows must all be 'Hs,Tp' or all 'Hs,Tp,beta_deg'; "
+            f"got column counts {sorted(ncol)}"
+        )
+    cases = np.asarray(rows, dtype=float)
+    if cases.shape[1] == 3:
+        cases[:, 2] = np.deg2rad(cases[:, 2])
+
+    from raft_tpu.parallel import make_wave_states, sweep_sea_states
+
+    # reuse the shared pipeline setup, staging the nominal mooring/statics
+    # at the table's most severe case
+    ea = argparse.Namespace(
+        design=args.design, thrust=args.thrust, bem=args.bem,
+        dz_max=args.dz_max, da_max=args.da_max,
+        hs=float(cases[:, 0].max()),
+        tp=float(cases[cases[:, 0].argmax(), 1]),
+        wmin=args.wmin, wmax=args.wmax, dw=args.dw,
+    )
+    headings = np.unique(cases[:, 2]) if cases.shape[1] == 3 else None
+    members, rna, env, wave, C_moor, model = _build_pipeline_inputs(
+        ea, headings=headings if args.bem else None
+    )
+    bem = None
+    if args.bem:
+        # heading grid staged when the table carries headings, else the
+        # single-heading solve from calcSystemProps
+        bem = model._bem_headings if headings is not None else model.bem
+    waves = make_wave_states(np.asarray(wave.w), cases, float(env.depth))
+    out = sweep_sea_states(members, rna, env, waves, C_moor, bem=bem)
+    result = {
+        "cases": cases.tolist(),
+        "columns": ["Hs", "Tp"] + (["beta_rad"] if cases.shape[1] == 3 else []),
+        "std dev": out["std dev"].tolist(),
+        "nacelle accel std dev": out["nacelle accel std dev"].tolist(),
+        "iterations": out["iterations"].tolist(),
+    }
+    print(json.dumps(result))
+    return result
 
 
 def main_optimize(argv):
@@ -142,7 +239,7 @@ def main_optimize(argv):
 
     from raft_tpu.parallel import optimize_design
 
-    members, rna, env, wave, C_moor = _build_pipeline_inputs(args)
+    members, rna, env, wave, C_moor, _ = _build_pipeline_inputs(args)
     apply = _param_fn(members, args.params)
     res = optimize_design(
         members, rna, env, wave, C_moor,
@@ -165,13 +262,15 @@ def main(argv=None):
     argv = list(sys.argv[1:] if argv is None else argv)
     # subcommand dispatch; a design file literally named like a subcommand
     # still wins (analyze ./sweep by path) because existing paths short-circuit
-    if argv and argv[0] in ("sweep", "optimize") and not os.path.isfile(argv[0]):
-        return {"sweep": main_sweep, "optimize": main_optimize}[argv[0]](argv[1:])
+    if argv and argv[0] in ("sweep", "optimize", "dlc") and not os.path.isfile(argv[0]):
+        return {"sweep": main_sweep, "optimize": main_optimize,
+                "dlc": main_dlc}[argv[0]](argv[1:])
     p = argparse.ArgumentParser(
         description="raft_tpu frequency-domain analysis",
         epilog="subcommands: 'raft-tpu sweep ...' (batched design-variant "
-               "sweep) and 'raft-tpu optimize ...' (gradient co-design); "
-               "see 'raft-tpu sweep --help' / 'raft-tpu optimize --help'.",
+               "sweep), 'raft-tpu dlc ...' (sea-state/heading case table), "
+               "and 'raft-tpu optimize ...' (gradient co-design); see "
+               "'raft-tpu <subcommand> --help'.",
     )
     p.add_argument("design", help="design YAML path or bundled name: "
                                   + "/".join(_BUNDLED))

@@ -566,11 +566,17 @@ def interp_heading_excitation(betas, F_all, beta: float) -> np.ndarray:
     vectorized blend of the two bracketing heading slices, not a per-
     (component, frequency) loop."""
     betas = np.asarray(betas)
-    if beta < betas[0] - 1e-9 or beta > betas[-1] + 1e-9:
+    # tolerance sized for float32 round-trips: a heading that passed
+    # through a device array (e.g. WaveState.beta under default f32) can
+    # differ from the staged grid value in the 7th decimal — that is the
+    # same physical heading, not an out-of-grid request (1e-6 rad ~ 6e-5
+    # deg)
+    if beta < betas[0] - 1e-6 or beta > betas[-1] + 1e-6:
         raise ValueError(
             f"heading {beta:.3f} rad outside staged grid "
             f"[{betas[0]:.3f}, {betas[-1]:.3f}]"
         )
+    beta = float(np.clip(beta, betas[0], betas[-1]))
     if len(betas) == 1:
         return np.asarray(F_all[0])
     j = int(np.clip(np.searchsorted(betas, beta), 1, len(betas) - 1))

@@ -426,13 +426,15 @@ def sweep_sea_states(
         Fb = np.moveaxis(np.asarray(F_rows), -1, 1)          # (B,nw,6)
         staged = (A_dev, B_dev, jnp.asarray(Fb.real), jnp.asarray(Fb.imag))
 
+    from raft_tpu.parallel.optimize import nacelle_accel_std
+
     def one(wave, F_re, F_im):
         # forward_response folds the lane's wave.beta into env itself
         b = (_stage_zeta((staged[0], staged[1], F_re, F_im), wave.zeta)
              if staged is not None else None)
         out = forward_response(members, rna, env, wave, C_moor, bem=b,
                                n_iter=n_iter)
-        return out.Xi.abs2(), out.n_iter
+        return out.Xi.abs2(), nacelle_accel_std(out.Xi, wave, rna), out.n_iter
 
     # dummy per-case excitation keeps one vmap signature when bem is None
     F_re = staged[2] if staged is not None else jnp.zeros((B, 1))
@@ -448,10 +450,11 @@ def sweep_sea_states(
         fn = jax.jit(jax.vmap(one), in_shardings=(sharding,) * 3)
     else:
         fn = jax.jit(jax.vmap(one))
-    abs2, iters = fn(waves, F_re, F_im)
+    abs2, a_nac, iters = fn(waves, F_re, F_im)
     sigma = response_std(abs2, waves.w[0])
     return {
         "std dev": np.asarray(sigma),
+        "nacelle accel std dev": np.asarray(a_nac),
         "iterations": np.asarray(iters),
         "Xi_abs2": np.asarray(abs2),
     }

@@ -17,3 +17,24 @@ import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+
+_last_module = [None]
+
+
+def pytest_runtest_setup(item):
+    """Clear JAX's compiled-executable caches at each MODULE boundary.
+
+    XLA-CPU's JIT can segfault after a few hundred live compiled
+    executables accumulate in one long process (the reason ci.yml splits
+    the nightly suite into two process chunks).  Bounding the live-
+    executable count per module makes a raw single-process
+    ``pytest tests/`` safe too; warm-cache reuse within a module is
+    unaffected.  (A runtest_setup hook, not a collection-time marker:
+    fixture closures are already fixed by collection time, so markers
+    added in pytest_collection_modifyitems cannot schedule a fixture.)
+    """
+    name = getattr(getattr(item, "module", None), "__name__", None)
+    if _last_module[0] is not None and name != _last_module[0]:
+        jax.clear_caches()
+    _last_module[0] = name

@@ -51,6 +51,74 @@ def test_cli_sweep_json(capsys):
 
 
 @pytest.mark.slow
+def test_cli_dlc_json(capsys, tmp_path):
+    import json
+
+    from raft_tpu.cli import main
+
+    f = tmp_path / "cases.csv"
+    # a bare spreadsheet header (no '#') must be tolerated
+    f.write_text("Hs, Tp, beta_deg\n6, 10, 0\n6, 10, 40\n8, 12, 40\n")
+    res = main(["dlc", "oc3", "--cases", str(f),
+                "--wmin", "0.2", "--wmax", "1.4", "--dw", "0.2"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    assert out["columns"] == ["Hs", "Tp", "beta_rad"]
+    sig = np.asarray(res["std dev"])
+    assert sig.shape == (3, 6) and np.isfinite(sig).all()
+    # heading moved energy into sway between the two (6, 10) cases
+    assert sig[1, 1] > sig[0, 1] + 1e-9
+    # bad column mix and a non-numeric BODY row are clean errors
+    g = tmp_path / "bad.csv"
+    g.write_text("6, 10\n6, 10, 40\n")
+    with pytest.raises(SystemExit, match="column"):
+        main(["dlc", "oc3", "--cases", str(g),
+              "--wmin", "0.2", "--wmax", "1.4", "--dw", "0.2"])
+    h = tmp_path / "worse.csv"
+    h.write_text("6, 10, 0\nsix, ten, forty\n")
+    with pytest.raises(SystemExit, match="non-numeric"):
+        main(["dlc", "oc3", "--cases", str(h),
+              "--wmin", "0.2", "--wmax", "1.4", "--dw", "0.2"])
+
+
+def test_interp_heading_f32_roundtrip_endpoint():
+    """A grid-endpoint heading that round-tripped through a float32 device
+    array (7th-decimal overshoot) is the same physical heading — it must
+    interpolate, not raise 'outside staged grid'."""
+    from raft_tpu.model import interp_heading_excitation
+
+    betas = np.array([0.0, np.deg2rad(30.0)])
+    F_all = np.zeros((2, 6, 4), complex)
+    F_all[1] += 1.0
+    b32 = float(np.float32(betas[1]))
+    assert b32 > betas[1]                  # the overshoot this guards
+    F = interp_heading_excitation(betas, F_all, b32)
+    np.testing.assert_allclose(F, F_all[1], atol=1e-6)
+    with pytest.raises(ValueError, match="outside staged grid"):
+        interp_heading_excitation(betas, F_all, betas[1] + 1e-3)
+
+
+@pytest.mark.slow
+def test_cli_dlc_bem_heading_grid(capsys, tmp_path):
+    """--bem stages ONE native heading-grid solve; per-case excitation is
+    interpolated to each row's heading."""
+    import json
+
+    from raft_tpu.cli import main
+
+    f = tmp_path / "cases.csv"
+    f.write_text("6, 10, 0\n6, 10, 40\n")
+    res = main(["dlc", "oc3", "--cases", str(f), "--bem",
+                "--dz-max", "6", "--da-max", "6",
+                "--wmin", "0.3", "--wmax", "1.4", "--dw", "0.3"])
+    out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])
+    sig = np.asarray(res["std dev"])
+    assert sig.shape == (2, 6) and np.isfinite(sig).all()
+    assert out["columns"] == ["Hs", "Tp", "beta_rad"]
+    # identical sea state, different heading -> different response split
+    assert abs(sig[0, 0] - sig[1, 0]) > 1e-9 or sig[1, 1] > sig[0, 1]
+
+
+@pytest.mark.slow
 def test_cli_optimize_json(capsys):
     import json
 

@@ -197,6 +197,21 @@ def test_array_eigen_with_staged_bem_matches_single():
     assert np.abs(f1 - f0).max() / np.abs(f0).max() > 1e-3
 
 
+def test_array_history_diagnostic():
+    """history=True surfaces each turbine's per-iteration convergence error."""
+    a = ArrayModel(load_design(OC3), nT=2, w=W)
+    a.setEnv(Hs=8.0, Tp=12.0)
+    a.calcSystemProps()
+    a.calcMooringAndOffsets()
+    a.solveDynamics(history=True)
+    h = a.results["response"]["iteration error history"]
+    n = a.results["response"]["iterations"]
+    assert h.shape == (2, 40)
+    for t in range(2):
+        assert np.isfinite(h[t, : int(n[t])]).all()
+        assert np.isnan(h[t, int(n[t]):]).all()
+
+
 def test_mixed_design_array_with_bem_raises():
     d3, d4 = load_design(OC3), load_design(OC4)
     with pytest.raises(NotImplementedError):

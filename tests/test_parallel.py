@@ -112,6 +112,8 @@ def test_sweep_sea_states_heading_axis():
     out = sweep_sea_states(members, rna, env, waves, C_moor)
     # cases 0 and 1 share (Hs, Tp): only the heading separates them
     assert np.abs(out["std dev"][0] - out["std dev"][1]).max() > 1e-9
+    a_nac = out["nacelle accel std dev"]
+    assert a_nac.shape == (3,) and np.isfinite(a_nac).all() and (a_nac > 0).all()
     for i, (Hs, Tp, beta) in enumerate(cases):
         wi = WaveState(w=waves.w[i], k=waves.k[i], zeta=waves.zeta[i])
         ref = forward_response(members, rna, env.replace(beta=beta), wi, C_moor)

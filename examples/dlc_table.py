@@ -15,7 +15,9 @@ from raft_tpu.build.members import build_member_set, build_rna
 from raft_tpu.core.types import Env
 from raft_tpu.model import load_design
 from raft_tpu.mooring import mooring_stiffness, parse_mooring
-from raft_tpu.parallel import make_wave_states, sweep_sea_states
+from raft_tpu.parallel import (
+    directional_response, make_wave_states, spread_sea_state, sweep_sea_states,
+)
 
 HERE = os.path.dirname(os.path.abspath(__file__))
 DESIGN = os.path.join(HERE, "..", "raft_tpu", "designs", "OC3spar.yaml")
@@ -48,6 +50,14 @@ def main(nw: int = 100):
         print(f"{Hs:5.1f} {Tp:5.1f} {np.rad2deg(beta):4.0f}d | "
               f"{sig[0]:9.3f} {sig[1]:9.3f} {sig[2]:9.3f} "
               f"{np.rad2deg(sig[4]):8.3f}d {int(it):5d}")
+
+    # the same (8 m, 12 s) sea as short-crested: cos^2s spreading splits
+    # the energy into direction lanes that ride the same batched solve
+    waves_dir = spread_sea_state(np.asarray(w), 8.0, 12.0, depth,
+                                 beta0=0.0, n_dir=7, s=2.0)
+    sc = directional_response(members, rna, env, waves_dir, C_moor)
+    print(f"short-crested 8.0m/12.0s (n_dir=7, s=2): surge std "
+          f"{sc['std dev'][0]:.3f}, sway std {sc['std dev'][1]:.3f}")
 
 
 if __name__ == "__main__":

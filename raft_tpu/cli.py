@@ -71,10 +71,13 @@ def _build_pipeline_inputs(args, headings=None):
         # current heading's excitation by interpolation)
         env_kw["beta"] = float(np.asarray(headings, dtype=float)[0])
     model.setEnv(Hs=args.hs, Tp=args.tp, Fthrust=thrust, **env_kw)
-    if use_bem and headings is not None:
+    if use_bem:
+        # explicit call so the mesh knobs apply with OR without a heading
+        # grid (calcSystemProps' implicit calcBEM would use its defaults)
         model.calcBEM(dz_max=getattr(args, "dz_max", 3.0),
                       da_max=getattr(args, "da_max", 2.0),
-                      headings=np.asarray(headings, dtype=float))
+                      headings=(np.asarray(headings, dtype=float)
+                                if headings is not None else None))
     model.calcSystemProps()
     model.calcMooringAndOffsets()
     return model.members, model.rna, model.env, model.wave, model.C_moor, model
@@ -172,14 +175,16 @@ def main_dlc(argv):
             try:
                 rows.append([float(x) for x in ln.replace(",", " ").split()])
             except ValueError:
-                if lineno == 1:           # a spreadsheet header line
-                    continue
+                if not rows:              # spreadsheet header line(s) before
+                    continue              # the first numeric row
                 raise SystemExit(
                     f"{args.cases}:{lineno}: non-numeric case row {ln!r} "
                     f"(rows are 'Hs,Tp' or 'Hs,Tp,beta_deg')"
                 )
+    if not rows:
+        raise SystemExit(f"{args.cases}: no numeric case rows found")
     ncol = {len(r) for r in rows}
-    if not rows or ncol not in ({2}, {3}):
+    if ncol not in ({2}, {3}):
         raise SystemExit(
             f"--cases rows must all be 'Hs,Tp' or all 'Hs,Tp,beta_deg'; "
             f"got column counts {sorted(ncol)}"

@@ -144,3 +144,37 @@ def wave_kinematics(
     ud = (u * w).mul_i()
     pDyn = zeta * (rho * g * cc)
     return u, ud, pDyn
+
+
+def spreading_weights(n_dir: int = 7, s: float = 2.0, max_offset: float = None):
+    """Discrete cos^2s directional spreading: (offsets [rad], weights).
+
+    D(theta) ∝ cos^2s(theta) over (-pi/2, pi/2) about the mean heading —
+    the standard offshore short-crested-sea spreading function (the
+    reference is strictly long-crested; this is a beyond-reference
+    capability).  Midpoint discretization at ``n_dir`` equally spaced
+    offsets, numerically normalized so the weights sum to 1 (each
+    direction carries the fraction ``w_j`` of the total wave energy).
+    ``n_dir=1`` or ``s=inf`` degenerate to a single long-crested lane.
+
+    Host/NumPy on purpose: this runs once at sea-state staging time, not
+    inside the compiled solve.
+    """
+    import numpy as np
+
+    if n_dir < 1:
+        raise ValueError(f"n_dir must be >= 1, got {n_dir}")
+    if n_dir == 1 or not np.isfinite(s):
+        return np.zeros(1), np.ones(1)
+    half = 0.5 * np.pi if max_offset is None else float(max_offset)
+    if not 0.0 < half <= 0.5 * np.pi:
+        # beyond pi/2 the cos weight goes negative (or NaN for fractional
+        # s) — that is outside the spreading function's support
+        raise ValueError(f"max_offset must be in (0, pi/2], got {half}")
+    # midpoints of n_dir equal bins spanning (-half, half): the open
+    # interval endpoints (where D=0 for the pi/2 span) are never sampled
+    edges = np.linspace(-half, half, n_dir + 1)
+    offsets = 0.5 * (edges[:-1] + edges[1:])
+    D = np.cos(offsets) ** (2.0 * s)
+    w = D / D.sum()
+    return offsets, w

@@ -6,11 +6,13 @@ from raft_tpu.parallel.geometry import (  # noqa: F401
     substructure_masks,
 )
 from raft_tpu.parallel.optimize import (  # noqa: F401
+    energy_sum,
     grad_nacelle_accel_std,
     nacelle_accel_std,
     optimize_design,
 )
 from raft_tpu.parallel.sweep import (  # noqa: F401
+    directional_response,
     forward_response,
     forward_response_dp_sp,
     forward_response_freq_sharded,
@@ -19,6 +21,7 @@ from raft_tpu.parallel.sweep import (  # noqa: F401
     make_wave_states,
     response_std,
     scale_diameters,
+    spread_sea_state,
     stage_bem,
     sweep,
     sweep_sea_states,

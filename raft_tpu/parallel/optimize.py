@@ -60,6 +60,15 @@ def nacelle_accel_std(Xi: Cx, wave: WaveState, rna: RNA) -> Array:
     return jnp.where(s > 0, jnp.sqrt(s_safe), 0.0)
 
 
+def energy_sum(sigmas):
+    """``case_reduce`` for directionally-spread lanes
+    (:func:`~raft_tpu.parallel.sweep.spread_sea_state`): the lanes are
+    independent linear components of ONE short-crested sea, so their std
+    devs combine as a root-sum-of-squares — unlike a DLC table, where the
+    default worst-case ``max`` is the robust choice."""
+    return jnp.sqrt(jnp.sum(sigmas ** 2))
+
+
 def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
                n_iter, remat, case_reduce=None):
     """theta -> objective(Xi) through the reverse-differentiable pipeline.
@@ -73,16 +82,49 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
 
     ``bem`` is detected by layout: :func:`~raft_tpu.parallel.sweep.
     stage_bem` output (excitation already zeta-scaled to ONE sea state,
-    valid for a single wave only) or the raw host coefficient tuple
-    (A[6,6,nw], B[6,6,nw], F[6,nw]), which works for both — the
-    case-dependent zeta scaling then happens per case.
+    valid for a single wave only), the raw host coefficient tuple
+    (A[6,6,nw], B[6,6,nw], F[6,nw]) — valid when all lanes share one
+    heading; the case-dependent zeta scaling then happens per case — or,
+    when the lanes carry their own headings (``wave.beta`` set, e.g. a
+    :func:`~raft_tpu.parallel.sweep.spread_sea_state`), the staged heading
+    GRID (betas, F_all[nb,6,nw], A, B) from ``Model.calcBEM(headings=...)``
+    so each lane's excitation is interpolated to its heading, exactly as
+    in :func:`~raft_tpu.parallel.sweep.sweep_sea_states`.
     """
+    import numpy as np
+
     batched = wave.zeta.ndim == 2
     if case_reduce is None:
         case_reduce = jnp.max
-    staged = None
+    staged = None       # per-case zeta staging of one shared-heading layout
+    staged_F = None     # per-lane heading-interpolated excitation
     if bem is not None:
-        if isinstance(bem[2], Cx):            # stage_bem output
+        if len(bem) == 4:                     # staged heading grid
+            from raft_tpu.model import interp_heading_excitation
+
+            bgrid, F_all, A_h, B_h = bem
+            if batched:
+                B_case = int(wave.zeta.shape[0])
+                betas_eval = (np.asarray(wave.beta) if wave.beta is not None
+                              else np.full(B_case, float(env.beta)))
+            else:
+                betas_eval = np.asarray([
+                    float(env.beta) if wave.beta is None else float(wave.beta)
+                ])
+            F_rows = np.stack([
+                interp_heading_excitation(np.asarray(bgrid), F_all, float(b))
+                for b in betas_eval
+            ])                                # (B,6,nw) complex
+            A_dev, B_dev, _, _ = _bem_device_layout((A_h, B_h, F_rows[0]))
+            Fb = np.moveaxis(F_rows, -1, 1)   # (B,nw,6)
+            if batched:
+                staged_F = (A_dev, B_dev,
+                            jnp.asarray(Fb.real), jnp.asarray(Fb.imag))
+            else:
+                bem = _stage_zeta(
+                    (A_dev, B_dev, jnp.asarray(Fb.real[0]),
+                     jnp.asarray(Fb.imag[0])), wave.zeta)
+        elif isinstance(bem[2], Cx):          # stage_bem output
             if batched:
                 raise ValueError(
                     "batched sea states need the raw (A[6,6,nw], B[6,6,nw], "
@@ -90,13 +132,25 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
                     "zeta scaling is per-case"
                 )
         else:                                 # raw host layout: stage here
+            if batched and wave.beta is not None:
+                raise ValueError(
+                    "lanes vary the wave heading but bem is a single-heading "
+                    "(A, B, F) tuple; pass the staged heading grid "
+                    "(betas, F_all, A, B) from Model.calcBEM(headings=...) "
+                    "so each lane's excitation matches its heading"
+                )
             staged = _bem_device_layout(bem)
             if not batched:
                 bem = _stage_zeta(staged, wave.zeta)
                 staged = None
 
-    def solve_one(m, wv):
-        b = _stage_zeta(staged, wv.zeta) if staged is not None else bem
+    def solve_one(m, wv, F_re=None, F_im=None):
+        if F_re is not None:
+            b = _stage_zeta((staged_F[0], staged_F[1], F_re, F_im), wv.zeta)
+        elif staged is not None:
+            b = _stage_zeta(staged, wv.zeta)
+        else:
+            b = bem
         out = forward_response(
             members=m, rna=rna, env=env, wave=wv, C_moor=C_moor,
             bem=b, n_iter=n_iter, method="scan", remat=remat,
@@ -106,7 +160,13 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
     def loss(theta):
         m = apply_fn(members, theta)
         if batched:
-            return case_reduce(jax.vmap(lambda wv: solve_one(m, wv))(wave))
+            if staged_F is not None:
+                per = jax.vmap(
+                    lambda wv, fr, fi: solve_one(m, wv, fr, fi)
+                )(wave, staged_F[2], staged_F[3])
+            else:
+                per = jax.vmap(lambda wv: solve_one(m, wv))(wave)
+            return case_reduce(per)
         return solve_one(m, wave)
 
     return loss

@@ -57,8 +57,10 @@ def test_cli_dlc_json(capsys, tmp_path):
     from raft_tpu.cli import main
 
     f = tmp_path / "cases.csv"
-    # a bare spreadsheet header (no '#') must be tolerated
-    f.write_text("Hs, Tp, beta_deg\n6, 10, 0\n6, 10, 40\n8, 12, 40\n")
+    # comment lines AND a bare spreadsheet header must be tolerated,
+    # including a header that follows a comment
+    f.write_text("# DLC set 1\nHs, Tp, beta_deg\n6, 10, 0\n6, 10, 40\n"
+                 "8, 12, 40\n")
     res = main(["dlc", "oc3", "--cases", str(f),
                 "--wmin", "0.2", "--wmax", "1.4", "--dw", "0.2"])
     out = json.loads(capsys.readouterr().out.strip().splitlines()[-1])

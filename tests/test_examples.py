@@ -51,6 +51,9 @@ def test_dlc_table_example(capsys):
     # headings actually act: the off-axis cases put energy into sway
     sway = [float(ln.split("|")[1].split()[1]) for ln in lines[1:]]
     assert sway[0] < 1e-6 < sway[-1]
+    # and the short-crested demo ran with nonzero spread sway
+    sc = [ln for ln in out.splitlines() if ln.startswith("short-crested")]
+    assert len(sc) == 1 and float(sc[0].split("sway std ")[1]) > 1e-6
 
 
 def test_analyze_example(capsys):

@@ -142,6 +142,85 @@ def test_robust_dlc_objective_and_descent(oc3):
     assert res.history[-1] < res.history[0]
 
 
+def test_short_crested_codesign(oc3):
+    """Optimization over a directionally-spread sea: the energy_sum reduce
+    equals the RSS of per-direction objectives (each lane's heading carried
+    through the loss), the gradient matches finite differences, and the
+    optimizer descends it."""
+    import jax
+    from raft_tpu.core.types import WaveState
+    from raft_tpu.parallel import scale_diameters, spread_sea_state
+    from raft_tpu.parallel.optimize import _make_loss, energy_sum
+
+    members, rna, env, wave, C_moor = oc3
+    w = np.asarray(wave.w)
+    waves = spread_sea_state(w, 8.0, 12.0, float(env.depth), n_dir=3, s=2.0)
+
+    loss = _make_loss(members, rna, env, waves, C_moor, nacelle_accel_std,
+                      scale_diameters, None, 25, False,
+                      case_reduce=energy_sum)
+    var = 0.0
+    for j in range(3):
+        wv = WaveState(w=waves.w[j], k=waves.k[j], zeta=waves.zeta[j])
+        out = forward_response(members, rna,
+                               env.replace(beta=float(waves.beta[j])),
+                               wv, C_moor, n_iter=25)
+        var += float(nacelle_accel_std(out.Xi, wv, rna)) ** 2
+    assert float(loss(jnp.asarray(1.0))) == pytest.approx(np.sqrt(var), rel=1e-9)
+
+    g = float(jax.grad(loss)(jnp.asarray(1.0)))
+    h = 1e-4
+    fd = (float(loss(jnp.asarray(1.0 + h)))
+          - float(loss(jnp.asarray(1.0 - h)))) / (2 * h)
+    assert g == pytest.approx(fd, rel=2e-3)
+
+    res = optimize_design(members, rna, env, waves, C_moor, theta0=1.0,
+                          steps=3, learning_rate=0.02, bounds=(0.85, 1.2),
+                          case_reduce=energy_sum)
+    assert res.history[-1] < res.history[0]
+
+
+def test_short_crested_codesign_with_bem_heading_grid(oc3):
+    """Short-crested optimization with potential-flow coefficients: each
+    direction lane's BEM excitation is interpolated to its own heading
+    from the staged grid (exactly as sweep_sea_states does); a raw
+    single-heading tuple under heading-varying lanes is rejected."""
+    from raft_tpu.core.types import WaveState
+    from raft_tpu.model import interp_heading_excitation
+    from raft_tpu.parallel import spread_sea_state, stage_bem
+    from raft_tpu.parallel import scale_diameters
+    from raft_tpu.parallel.optimize import _make_loss, energy_sum
+
+    members, rna, env, wave, C_moor = oc3
+    w = np.asarray(wave.w)
+    nw = len(w)
+    waves = spread_sea_state(w, 8.0, 12.0, float(env.depth), n_dir=3, s=2.0)
+    rng = np.random.default_rng(5)
+    A = np.tile(np.eye(6)[:, :, None] * 5e6, (1, 1, nw))
+    Bh = np.tile(np.eye(6)[:, :, None] * 1e5, (1, 1, nw))
+    bgrid = np.array([-1.1, 1.1])          # covers the +-pi/3 lane offsets
+    F_all = (rng.normal(size=(2, 6, nw))
+             + 1j * rng.normal(size=(2, 6, nw))) * 1e5
+
+    loss = _make_loss(members, rna, env, waves, C_moor, nacelle_accel_std,
+                      scale_diameters, (bgrid, F_all, A, Bh), 25, False,
+                      case_reduce=energy_sum)
+    var = 0.0
+    for j in range(3):
+        beta_j = float(waves.beta[j])
+        wv = WaveState(w=waves.w[j], k=waves.k[j], zeta=waves.zeta[j])
+        F_j = interp_heading_excitation(bgrid, F_all, beta_j)
+        out = forward_response(members, rna, env.replace(beta=beta_j), wv,
+                               C_moor, bem=stage_bem((A, Bh, F_j), wv),
+                               n_iter=25)
+        var += float(nacelle_accel_std(out.Xi, wv, rna)) ** 2
+    assert float(loss(jnp.asarray(1.0))) == pytest.approx(np.sqrt(var), rel=1e-9)
+
+    with pytest.raises(ValueError, match="heading"):
+        _make_loss(members, rna, env, waves, C_moor, nacelle_accel_std,
+                   scale_diameters, (A, Bh, F_all[0]), 25, False)
+
+
 def test_robust_dlc_with_raw_bem_matches_per_case(oc3):
     """Batched waves + BEM: the per-case zeta re-staging inside the robust
     loss equals staging each case by hand; stage_bem output is rejected

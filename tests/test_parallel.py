@@ -172,6 +172,67 @@ def test_sweep_sea_states_heading_axis_with_bem_grid():
                          bem=(A, B, F_all[0]))
 
 
+def test_spreading_weights_properties():
+    from raft_tpu.core.waves import spreading_weights
+
+    off, w = spreading_weights(n_dir=9, s=2.0)
+    assert w.sum() == pytest.approx(1.0)
+    np.testing.assert_allclose(w, w[::-1])            # symmetric about 0
+    np.testing.assert_allclose(off, -off[::-1])
+    assert w[4] == w.max()                            # peaked at the mean
+    # larger s concentrates energy toward the mean heading
+    _, w8 = spreading_weights(n_dir=9, s=8.0)
+    assert w8[4] > w[4]
+    # degenerate single-lane forms
+    for kw in ({"n_dir": 1}, {"s": np.inf}):
+        off1, w1 = spreading_weights(**kw)
+        assert off1.shape == (1,) and w1[0] == 1.0
+
+
+def test_directional_response_matches_manual_sum():
+    """Short-crested sea: the spread response equals the per-direction
+    manual combination, and n_dir=1 degenerates to the long-crested solve."""
+    import __graft_entry__ as ge
+    from raft_tpu.core.types import WaveState
+    from raft_tpu.core.waves import spreading_weights
+    from raft_tpu.parallel import (
+        directional_response, forward_response, response_std,
+        spread_sea_state,
+    )
+
+    design, members, rna, env, wave = ge._base(nw=12)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    w = np.asarray(wave.w)
+
+    waves_dir = spread_sea_state(w, 8.0, 12.0, float(env.depth), beta0=0.0,
+                                 n_dir=3, s=2.0)
+    out = directional_response(members, rna, env, waves_dir, C_moor)
+
+    offsets, wts = spreading_weights(n_dir=3, s=2.0)
+    var = np.zeros(6)
+    for j in range(3):
+        wj = WaveState(w=waves_dir.w[j], k=waves_dir.k[j],
+                       zeta=waves_dir.zeta[j])
+        ref = forward_response(members, rna, env.replace(beta=float(offsets[j])),
+                               wj, C_moor)
+        var += np.asarray(response_std(ref.Xi.abs2(), wj.w)) ** 2
+    np.testing.assert_allclose(out["std dev"], np.sqrt(var), rtol=1e-9)
+
+    # short-crestedness puts energy into sway on an axisymmetric hull at
+    # beta0=0, and reduces the surge response vs the long-crested sea
+    single = spread_sea_state(w, 8.0, 12.0, float(env.depth), n_dir=1)
+    out1 = directional_response(members, rna, env, single, C_moor)
+    assert out["std dev"][1] > 1e-6                   # sway excited
+    assert out["std dev"][0] < out1["std dev"][0]     # surge energy spread
+    # long-crested degenerate case == plain single-heading solve
+    ref1 = forward_response(members, rna, env, wave, C_moor)
+    sig1 = np.asarray(response_std(ref1.Xi.abs2(), wave.w))
+    np.testing.assert_allclose(out1["std dev"], sig1, rtol=1e-9)
+
+
 @pytest.mark.slow
 def test_2d_mesh_dp_sp_matches_unsharded():
     """Composed design x frequency parallelism: a (2, 4) mesh — design

@@ -37,6 +37,7 @@ from raft_tpu.mooring import (
     mooring_stiffness,
     parse_mooring,
     solve_equilibrium,
+    tension_jacobian,
 )
 from raft_tpu.solve import LinearCoeffs, diagonal_estimates, solve_dynamics, solve_eigen
 from raft_tpu.statics import assemble_statics
@@ -476,4 +477,28 @@ class ArrayModel:
         zeta = np.maximum(np.asarray(self.wave.zeta), 1e-12)
         self.results["response"]["nacelle acceleration"] = a_nac
         self.results["response"]["nacelle acceleration RAO"] = np.abs(a_nac) / zeta
+        # per-turbine design-constraint margins (cf. Model.calcOutputs; the
+        # reference carries these only as commented-out legacy code,
+        # raft/raft.py:1655-1698)
+        dw = float(w[1] - w[0]) if len(w) > 1 else 1.0
+        cons = {}
+        if self.r6_eq is not None and "means" in self.results:
+            margins = []
+            for t, mo in enumerate(self.moor):
+                if mo is None:
+                    margins.append(np.nan)   # no lines -> no slack constraint
+                    continue
+                J = np.asarray(tension_jacobian(mo, self.r6_eq[t]))  # (nl,6)
+                T_amp = Xi[t] @ J.T                                  # (nw,nl)
+                sig_T = np.sqrt((np.abs(T_amp) ** 2).sum(axis=0) * dw)
+                T_mean = np.asarray(
+                    self.results["means"]["fairlead tensions"][t])
+                margins.append(float((T_mean - 3.0 * sig_T).min()))
+            cons["slack line margin"] = np.asarray(margins)          # (nT,)
+        sig_p = np.asarray(self.results["response"]["std dev"])[:, 4]
+        static_p = (np.abs(np.asarray(self.r6_eq)[:, 4])
+                    if self.r6_eq is not None else np.zeros(self.nT))
+        cons["dynamic pitch"] = np.rad2deg(static_p + 3.0 * sig_p)   # (nT,)
+        cons["dynamic pitch limit"] = 10.0
+        self.results["constraints"] = cons
         return self.results

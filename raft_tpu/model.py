@@ -418,6 +418,21 @@ class Model:
             self.results["response"]["fairlead tension std dev"] = np.sqrt(
                 (np.abs(T_amp) ** 2).sum(axis=0) * dw
             )
+        # design-constraint margins the reference carries only as
+        # commented-out legacy code (raft/raft.py:1655-1698): slack-line
+        # margin min_l(T_mean_l - 3 sigma_T_l) (negative = a line can go
+        # slack at the 3-sigma excursion) and dynamic pitch
+        # |static| + 3 sigma_pitch vs the 10 deg limit used there
+        cons = {}
+        if "fairlead tension std dev" in self.results["response"]:
+            T_mean = np.asarray(self.results["means"]["fairlead tensions"])
+            sig_T = self.results["response"]["fairlead tension std dev"]
+            cons["slack line margin"] = float((T_mean - 3.0 * sig_T).min())
+        sig_p = float(self.results["response"]["std dev"][4])
+        static_p = (float(self.r6_eq[4]) if self.r6_eq is not None else 0.0)
+        cons["dynamic pitch"] = float(np.rad2deg(abs(static_p) + 3.0 * sig_p))
+        cons["dynamic pitch limit"] = 10.0
+        self.results["constraints"] = cons
         return self.results
 
     def print_report(self):
@@ -453,6 +468,14 @@ class Model:
             if "nacelle acceleration std dev" in self.results["response"]:
                 print(f"  nacelle accel std dev: "
                       f"{self.results['response']['nacelle acceleration std dev']:.3f} m/s^2")
+        if "constraints" in self.results:
+            c = self.results["constraints"]
+            if "slack line margin" in c:
+                print(f"  slack line margin (T - 3 sigma): "
+                      f"{c['slack line margin']:.4g} N")
+            print(f"  dynamic pitch (|static| + 3 sigma): "
+                  f"{c['dynamic pitch']:.2f} deg "
+                  f"(limit {c['dynamic pitch limit']:.0f})")
         print("================================")
 
     # ---------------------------------------------------------------- plot

@@ -159,7 +159,9 @@ def tension_jacobian(sys: MooringSystem, r6: Array) -> Array:
     The reference documents fairlead-tension RAOs as an intended output in
     a commented MATLAB-heritage block (raft/raft.py:1655-1708); combined
     with the platform response this linearization delivers them:
-    ``T_RAO(w) = J @ Xi(w)``.
+    ``T_RAO(w) = J @ Xi(w)``.  Jitted so facade callers (calcOutputs,
+    incl. the per-turbine array loop) hit one cached compilation per
+    mooring structure instead of an eager trace per call.
     """
     return jax.jacfwd(lambda x: fairlead_tensions(sys, x))(r6)
 

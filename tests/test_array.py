@@ -122,6 +122,14 @@ def test_array_outputs_nacelle_accel(pair):
     assert a_nac.shape == (2, len(W))
     assert np.isfinite(a_nac).all()
     np.testing.assert_allclose(a_nac[0], a_nac[1], rtol=1e-6, atol=1e-12)
+    # per-turbine constraint margins: identical co-located turbines agree
+    cons = out["constraints"]
+    assert cons["slack line margin"].shape == (2,)
+    assert cons["dynamic pitch"].shape == (2,)
+    np.testing.assert_allclose(cons["slack line margin"][0],
+                               cons["slack line margin"][1], rtol=1e-6)
+    assert (cons["dynamic pitch"] > 0).all()
+    assert (cons["dynamic pitch"] < cons["dynamic pitch limit"]).all()
 
 
 def test_array_with_staged_bem_matches_single():

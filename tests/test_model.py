@@ -111,6 +111,25 @@ def test_outputs_nacelle_accel(model):
     assert 0.01 < sd < 5.0              # m/s^2 in 8 m seas
 
 
+@pytest.mark.slow
+def test_outputs_constraint_margins(model):
+    """Design-constraint margins (the reference sketches these only in
+    commented-out legacy code, raft/raft.py:1655-1698): the OC3 in 8 m
+    seas keeps all lines taut at 3 sigma and stays under the 10 deg
+    dynamic-pitch limit used there."""
+    model.calcMooringAndOffsets()
+    model.solveDynamics()
+    results = model.calcOutputs()
+    cons = results["constraints"]
+    # taut-moored spar: comfortable positive slack margin [N]
+    assert cons["slack line margin"] > 1e5
+    # |static| + 3 sigma pitch well under the legacy 10 deg limit
+    assert 0.0 < cons["dynamic pitch"] < cons["dynamic pitch limit"]
+    # and consistent with the reported response: margin below the mean min
+    T_mean = results["means"]["fairlead tensions"]
+    assert cons["slack line margin"] < T_mean.min()
+
+
 def test_bem_excitation_basis_consistency():
     """BEM excitation (per unit wave amplitude) must be scaled by zeta
     before summing with the spectral-amplitude-basis Morison excitation."""

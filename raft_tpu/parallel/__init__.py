@@ -19,6 +19,7 @@ from raft_tpu.parallel.sweep import (  # noqa: F401
     grad_response_std,
     make_mesh,
     make_wave_states,
+    mixed_sea_state,
     response_std,
     scale_diameters,
     spread_sea_state,

@@ -488,6 +488,27 @@ def spread_sea_state(w, Hs, Tp, depth, beta0: float = 0.0, n_dir: int = 7,
     )
 
 
+def mixed_sea_state(w, components, depth, g: float = 9.81) -> WaveState:
+    """Multi-component (e.g. bimodal wind-sea + swell) sea state.
+
+    ``components``: rows of [Hs, Tp, beta] — each an independent JONSWAP
+    component with its own heading (a classic North-Sea case: local wind
+    sea at one heading plus long-period swell from a storm elsewhere).
+    Returns a batched WaveState with one lane per component, for
+    :func:`directional_response`: the components are independent linear
+    wave systems, so the total response variance is the lane sum — the
+    same combination rule as the directional-spreading lanes.  The
+    reference carries a single unimodal spectrum only.
+    """
+    comps = np.asarray(components, dtype=float)
+    if comps.ndim != 2 or comps.shape[1] != 3:
+        raise ValueError(
+            f"components must be rows of [Hs, Tp, beta]; got shape "
+            f"{comps.shape}"
+        )
+    return make_wave_states(w, comps, depth, g=g)
+
+
 def directional_response(
     members: MemberSet,
     rna: RNA,

@@ -233,6 +233,33 @@ def test_directional_response_matches_manual_sum():
     np.testing.assert_allclose(out1["std dev"], sig1, rtol=1e-9)
 
 
+def test_mixed_sea_bimodal_response():
+    """Wind sea + swell from different headings: the bimodal response is
+    the RSS of the component responses (independent linear systems), not
+    a per-case table reduction."""
+    from raft_tpu.core.types import WaveState
+    from raft_tpu.parallel import (
+        directional_response, mixed_sea_state, response_std,
+    )
+
+    members, rna, env, wave, C_moor = setup(nw=12)
+    w = np.asarray(wave.w)
+    comps = [[6.0, 9.0, 0.0], [3.0, 16.0, 1.2]]      # wind sea + swell
+    waves = mixed_sea_state(w, comps, float(env.depth))
+    out = directional_response(members, rna, env, waves, C_moor)
+
+    var = np.zeros(6)
+    for j, (Hs, Tp, beta) in enumerate(comps):
+        wj = WaveState(w=waves.w[j], k=waves.k[j], zeta=waves.zeta[j])
+        ref = forward_response(members, rna, env.replace(beta=beta), wj, C_moor)
+        var += np.asarray(response_std(ref.Xi.abs2(), wj.w)) ** 2
+    np.testing.assert_allclose(out["std dev"], np.sqrt(var), rtol=1e-9)
+    # the swell heading excites sway; the wind sea alone would not
+    assert out["std dev"][1] > 1e-6
+    with pytest.raises(ValueError, match="Hs, Tp, beta"):
+        mixed_sea_state(w, [[6.0, 9.0]], float(env.depth))
+
+
 @pytest.mark.slow
 def test_2d_mesh_dp_sp_matches_unsharded():
     """Composed design x frequency parallelism: a (2, 4) mesh — design

@@ -435,6 +435,58 @@ class Model:
         self.results["constraints"] = cons
         return self.results
 
+    def airgap(self, points, deck_z: float):
+        """Relative wave elevation and air-gap margin at deck points.
+
+        Linear-theory deck-clearance check (no analog in the reference):
+        the relative elevation at plan point p = (x, y) is the incident
+        elevation minus the structure's vertical motion there,
+        ``eta_rel(w) = zeta e^{-i k (x cos beta + y sin beta)} - u_z(p, w)``
+        with ``u_z = Xi_heave + Xi_roll y - Xi_pitch x`` (small-angle rigid
+        body).  The 3-sigma air gap is ``deck_z - eta_mean_offset - 3
+        sigma_rel``; negative means waves can reach the deck.
+
+        ``points``: (np, 2) plan coordinates [m]; ``deck_z``: underside of
+        deck above SWL [m].  Returns a dict with per-point sigma and
+        margins, and stores it under ``results["airgap"]``.
+        """
+        if self.rao is None:
+            raise RuntimeError("run solveDynamics first")
+        pts = np.atleast_2d(np.asarray(points, dtype=float))
+        if pts.ndim != 2 or pts.shape[-1] != 2:
+            raise ValueError(
+                f"points must be (np, 2) plan coordinates [x, y]; got shape "
+                f"{pts.shape}"
+            )
+        w = np.asarray(self.w)
+        k = np.asarray(self.wave.k)
+        zeta = np.asarray(self.wave.zeta)
+        beta = float(self.env.beta)
+        Xi = np.asarray(self.rao.Xi.to_complex())            # (nw,6)
+        dw = float(w[1] - w[0]) if len(w) > 1 else 1.0
+        phase_lag = np.exp(-1j * k[None, :] * (
+            pts[:, 0, None] * np.cos(beta) + pts[:, 1, None] * np.sin(beta)
+        ))                                                   # (np,nw)
+        eta = zeta[None, :] * phase_lag
+        u_z = (Xi[None, :, 2]
+               + Xi[None, :, 3] * pts[:, 1, None]
+               - Xi[None, :, 4] * pts[:, 0, None])           # (np,nw)
+        eta_rel = eta - u_z
+        sigma = np.sqrt((np.abs(eta_rel) ** 2).sum(axis=1) * dw)   # (np,)
+        # mean vertical offset of each deck point (heave/trim at the mean)
+        z_off = np.zeros(len(pts))
+        if self.r6_eq is not None:
+            r6 = np.asarray(self.r6_eq)
+            z_off = r6[2] + r6[3] * pts[:, 1] - r6[4] * pts[:, 0]
+        out = {
+            "points": pts,
+            "sigma rel elevation": sigma,
+            "margin 3 sigma": deck_z + z_off - 3.0 * sigma,
+            "deck_z": float(deck_z),
+        }
+        self.results["airgap"] = out
+        return out
+
     def print_report(self):
         """Human-readable property/results report (the reference prints this
         from calcOutputs, raft/raft.py:1606-1627)."""

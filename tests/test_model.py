@@ -130,6 +130,39 @@ def test_outputs_constraint_margins(model):
     assert cons["slack line margin"] < T_mean.min()
 
 
+@pytest.mark.slow
+def test_airgap_outputs(model):
+    """Relative wave elevation / air gap: at the spar centerline the
+    vertical motion is small, so sigma_rel ~ the incident elevation std
+    Hs/4; margins are monotone in deck height and pitch coupling makes
+    off-center points differ."""
+    model.calcMooringAndOffsets()
+    model.solveDynamics()
+    out = model.airgap([[0.0, 0.0], [30.0, 0.0]], deck_z=15.0)
+    sig = out["sigma rel elevation"]
+    # incident-elevation std = sqrt(int S dw) = Hs/4 = 2.0 m for Hs=8;
+    # the deep spar's heave/pitch motion shifts it only moderately
+    assert 1.5 < sig[0] < 3.0
+    # pitch lever makes the off-center point's relative motion different
+    assert abs(sig[1] - sig[0]) > 1e-3
+    # a 15 m deck on OC3 in 8 m seas keeps positive 3-sigma clearance;
+    # a 4 m deck does not
+    assert out["margin 3 sigma"][0] > 0.0
+    low = model.airgap([[0.0, 0.0]], deck_z=4.0)
+    assert low["margin 3 sigma"][0] < out["margin 3 sigma"][0]
+    # manual recompute of the relative-elevation spectrum at the center
+    w = np.asarray(model.w)
+    dw = float(w[1] - w[0])
+    Xi = np.asarray(model.rao.Xi.to_complex())
+    eta_rel = np.asarray(model.wave.zeta) - Xi[:, 2]
+    np.testing.assert_allclose(
+        sig[0], np.sqrt((np.abs(eta_rel) ** 2).sum() * dw), rtol=1e-9
+    )
+    assert "airgap" in model.results
+    with pytest.raises(ValueError, match="plan coordinates"):
+        model.airgap([[0.0, 0.0, 10.0]], deck_z=15.0)
+
+
 def test_bem_excitation_basis_consistency():
     """BEM excitation (per unit wave amplitude) must be scaled by zeta
     before summing with the spectral-amplitude-basis Morison excitation."""

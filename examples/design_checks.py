@@ -1,0 +1,48 @@
+"""Feasibility screening of one design: constraint margins + air gap.
+
+The checks the reference only sketches in commented-out legacy code
+(raft/raft.py:1655-1698), as a working screening recipe: solve a severe
+sea state, then report the slack-line margin, the dynamic-pitch margin,
+and the 3-sigma deck clearance at the platform corners — the numbers a
+designer looks at before anything else.
+"""
+import os
+
+import numpy as np
+
+from raft_tpu.model import Model, load_design
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DESIGN = os.path.join(HERE, "..", "raft_tpu", "designs", "OC3spar.yaml")
+
+
+def main(nw: int = 60, Hs: float = 10.0, Tp: float = 14.0,
+         deck_z: float = 12.0):
+    model = Model(load_design(DESIGN), w=np.linspace(0.05, 2.95, nw))
+    model.setEnv(Hs=Hs, Tp=Tp, Fthrust=800e3)
+    model.calcSystemProps()
+    model.calcMooringAndOffsets()
+    model.solveDynamics()
+    model.calcOutputs()
+
+    c = model.results["constraints"]
+    print(f"design screening: OC3 spar in Hs={Hs} m, Tp={Tp} s")
+    print(f"  slack line margin (T - 3 sigma): {c['slack line margin']:.4g} N"
+          f"  -> {'OK' if c['slack line margin'] > 0 else 'SLACK RISK'}")
+    print(f"  dynamic pitch |static| + 3 sigma: {c['dynamic pitch']:.2f} deg"
+          f" (limit {c['dynamic pitch limit']:.0f})"
+          f"  -> {'OK' if c['dynamic pitch'] < c['dynamic pitch limit'] else 'EXCEEDED'}")
+
+    # deck clearance at the spar edge, up/down-wave and abeam
+    r = 3.25                                       # OC3 top radius [m] (6.5 m dia)
+    pts = [[r, 0.0], [-r, 0.0], [0.0, r], [0.0, -r]]
+    gap = model.airgap(pts, deck_z=deck_z)
+    worst = int(np.argmin(gap["margin 3 sigma"]))
+    for (x, y), m3 in zip(pts, gap["margin 3 sigma"]):
+        print(f"  air gap at ({x:5.1f},{y:5.1f}): {m3:6.2f} m"
+              f"  -> {'OK' if m3 > 0 else 'DECK IMPACT RISK'}")
+    print(f"  critical deck point: ({pts[worst][0]:.1f}, {pts[worst][1]:.1f})")
+
+
+if __name__ == "__main__":
+    main()

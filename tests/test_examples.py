@@ -56,6 +56,15 @@ def test_dlc_table_example(capsys):
     assert len(sc) == 1 and float(sc[0].split("sway std ")[1]) > 1e-6
 
 
+def test_design_checks_example(capsys):
+    _load("design_checks").main(nw=16)
+    out = capsys.readouterr().out
+    assert "slack line margin" in out and "air gap" in out
+    # OC3 with a 12 m deck in 10 m seas screens OK on every check
+    assert "RISK" not in out and "EXCEEDED" not in out
+    assert "critical deck point" in out
+
+
 def test_analyze_example(capsys):
     _load("analyze_oc3").main()
     out = capsys.readouterr().out

@@ -1,4 +1,9 @@
 """Multi-device parallelism: design-batch sweeps over a TPU mesh."""
+from raft_tpu.parallel.multihost import (  # noqa: F401
+    global_mesh,
+    init_multihost,
+    stage_global,
+)
 from raft_tpu.parallel.geometry import (  # noqa: F401
     affine_warp,
     make_scale_plan,

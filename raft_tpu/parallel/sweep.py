@@ -21,6 +21,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from raft_tpu.core.cplx import Cx
 from raft_tpu.core.types import Env, MemberSet, RNA, WaveState
 from raft_tpu.hydro import node_kinematics, strip_added_mass, strip_excitation
+from raft_tpu.parallel.multihost import is_multiprocess, stage_global
 from raft_tpu.solve import LinearCoeffs, solve_dynamics
 from raft_tpu.statics import assemble_statics
 
@@ -232,6 +233,10 @@ def forward_response_freq_sharded(
         out_specs=out_specs,
         **kw,
     )
+    # on a mesh spanning several processes (multi-host), host arrays must
+    # first become global jax.Arrays — each process materializes its shards
+    if is_multiprocess(mesh):
+        wave, bem = stage_global((wave, bem), mesh, (wave_specs, bem_specs))
     return sharded(wave, bem)
 
 
@@ -321,6 +326,10 @@ def forward_response_dp_sp(
         out_specs=out_specs,
         **kw,
     )
+    if is_multiprocess(mesh):
+        thetas, wave, bem = stage_global(
+            (thetas, wave, bem), mesh, (P(axis_d), wave_specs, bem_specs)
+        )
     return sharded(thetas, wave, bem)
 
 

@@ -1,0 +1,48 @@
+"""Worker for tests/test_multihost.py — NOT a test module.
+
+Each of the two coordinated processes runs this same program (SPMD):
+join the distributed runtime, build the identical OC3 model, solve the
+RAO with the frequency axis sharded over the GLOBAL 8-device mesh
+(2 processes x 4 virtual CPU devices; the psum/pmax collectives cross
+the process boundary), gather the result, and print it from rank 0 for
+the parent test to compare against the single-process solve.
+"""
+import sys
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_enable_x64", True)      # match the test oracle
+
+pid = int(sys.argv[1])
+port = sys.argv[2]
+
+from raft_tpu.parallel.multihost import global_mesh, init_multihost  # noqa: E402
+
+init_multihost(f"localhost:{port}", num_processes=2, process_id=pid)
+
+import numpy as np  # noqa: E402
+import jax.numpy as jnp  # noqa: E402
+from jax.experimental import multihost_utils  # noqa: E402
+
+import __graft_entry__ as ge  # noqa: E402
+from raft_tpu.mooring import mooring_stiffness, parse_mooring  # noqa: E402
+from raft_tpu.parallel import forward_response_freq_sharded  # noqa: E402
+
+assert jax.process_count() == 2, jax.process_count()
+assert jax.device_count() == 8, jax.device_count()
+
+design, members, rna, env, wave = ge._base(nw=8)
+moor = parse_mooring(design["mooring"],
+                     yaw_stiffness=design["turbine"]["yaw_stiffness"])
+C_moor = mooring_stiffness(moor, jnp.zeros(6))
+
+mesh = global_mesh(("freq",))
+out = forward_response_freq_sharded(members, rna, env, wave, C_moor,
+                                    mesh=mesh, method="while")
+Xi_re = multihost_utils.process_allgather(out.Xi.re, tiled=True)
+Xi_im = multihost_utils.process_allgather(out.Xi.im, tiled=True)
+if pid == 0:
+    flat = np.stack([np.asarray(Xi_re), np.asarray(Xi_im)]).ravel()
+    print("XI", " ".join(f"{v:.17e}" for v in flat), flush=True)
+    print("NITER", int(out.n_iter), flush=True)

@@ -1,0 +1,92 @@
+"""Multi-process (multi-host) distributed solve reproduces single-process.
+
+The dry run and the sharding suite validate multi-DEVICE meshes inside one
+process; this test validates the multi-HOST layer: two OS processes join
+one ``jax.distributed`` runtime (the coordination path a TPU pod uses over
+DCN), form a single 8-device global mesh from 2 x 4 virtual CPU devices,
+and run the frequency-sharded RAO solve whose psum/pmax collectives cross
+the process boundary.  Rank 0 gathers and prints the response; the parent
+compares it against the in-process unsharded solve.
+"""
+import os
+import socket
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+pytestmark = pytest.mark.slow
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _free_port():
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def test_two_process_freq_sharded_matches_single_process():
+    port = _free_port()
+    env = {
+        **os.environ,
+        "XLA_FLAGS": "--xla_force_host_platform_device_count=4",
+        "JAX_PLATFORMS": "cpu",
+        # the worker runs by path, so its script dir (tests/) is on
+        # sys.path but the repo root is not
+        "PYTHONPATH": REPO + os.pathsep + os.environ.get("PYTHONPATH", ""),
+    }
+    worker = os.path.join(REPO, "tests", "multihost_worker.py")
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(pid), str(port)],
+            cwd=REPO, env=env, stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    # collect BOTH workers before asserting: if one dies early, its peer
+    # must still be reaped (it would otherwise block forever in the
+    # collective), and its output usually holds the root cause
+    outs = []
+    try:
+        for p in procs:
+            out, _ = p.communicate(timeout=600)
+            outs.append(out)
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.communicate()
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, (
+            "worker failed:\n" + "\n---\n".join(o[-2000:] for o in outs)
+        )
+    xi_line = next(ln for ln in outs[0].splitlines() if ln.startswith("XI "))
+    flat = np.array([float(v) for v in xi_line.split()[1:]])
+    Xi_mh = (flat[: len(flat) // 2] + 1j * flat[len(flat) // 2:]).reshape(8, 6)
+
+    # in-process oracle: same model, unsharded
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    import jax.numpy as jnp
+
+    import __graft_entry__ as ge
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+    from raft_tpu.parallel import forward_response
+
+    design, members, rna, env_m, wave = ge._base(nw=8)
+    moor = parse_mooring(design["mooring"],
+                         yaw_stiffness=design["turbine"]["yaw_stiffness"])
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    ref = forward_response(members, rna, env_m, wave, C_moor,
+                           n_iter=40, method="while")
+    Xi_ref = np.asarray(ref.Xi.to_complex())
+    scale = np.abs(Xi_ref).max()
+    assert np.abs(Xi_mh - Xi_ref).max() < 1e-9 * scale, (
+        f"multi-process mismatch {np.abs(Xi_mh - Xi_ref).max():.3e}"
+    )
+    niter = next(ln for ln in outs[0].splitlines() if ln.startswith("NITER"))
+    assert int(niter.split()[1]) == int(ref.n_iter)

@@ -1,0 +1,195 @@
+"""Pallas TPU kernel for the batched 6x6 complex solve (the hot op).
+
+The RAO engine's inner operation is thousands of independent 6x6 complex
+solves per fixed-point iteration (:mod:`raft_tpu.core.linalg6`'s unrolled
+elimination, vectorized over the batch by XLA).  This module is the same
+algorithm as ONE hand-written Pallas kernel: the batch lies along the TPU
+lane axis, every elimination/back-substitution step is an elementwise VPU
+operation over a VMEM-resident block, and partial pivoting is a lane-wise
+one-hot blend (no gathers).  One kernel invocation per block replaces the
+~200-op XLA fusion — the payoff is explicit control of the memory layout
+(matrix entries live in sublanes, systems in lanes) so a block's whole
+working set stays in VMEM across all 6 elimination steps.
+
+Status: OFF by default.  Bit-compared against ``linalg6.solve_cx`` in
+interpreter mode by ``tests/test_pallas6.py`` (the only mode available on
+this host — see DEVIATIONS.md); enable on real TPU hardware with
+``RAFT_TPU_PALLAS=1`` once measured.  Forward (inference) path only: the
+kernel defines no VJP, so the differentiable ``method="scan"`` route keeps
+the XLA implementation regardless of the flag.
+"""
+from __future__ import annotations
+
+import functools
+import os
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.core.cplx import Cx
+
+Array = jnp.ndarray
+
+_N = 6
+_BLOCK = 512          # systems per kernel invocation (lanes: 4 x 128)
+
+
+def enabled() -> bool:
+    """True when the env knob requests the Pallas solve path."""
+    return os.environ.get("RAFT_TPU_PALLAS", "0") == "1"
+
+
+def _kernel(zr_ref, zi_ref, br_ref, bi_ref, xr_ref, xi_ref):
+    """Unrolled 6x6 complex Gaussian elimination over a lane block.
+
+    Refs: zr/zi (36, B) row-major matrix entries, br/bi/xr/xi (6, B).
+    Every value below is a (1, B) vector; all arithmetic is elementwise
+    (VPU), and the per-lane pivot permutation is a one-hot blend.
+    """
+    Ar = [zr_ref[i:i + 1, :] for i in range(_N * _N)]
+    Ai = [zi_ref[i:i + 1, :] for i in range(_N * _N)]
+    br = [br_ref[i:i + 1, :] for i in range(_N)]
+    bi = [bi_ref[i:i + 1, :] for i in range(_N)]
+
+    def at(i, j):
+        return i * _N + j
+
+    for k in range(_N):
+        # lane-wise partial pivot: one-hot over candidate rows >= k
+        mags = [Ar[at(j, k)] ** 2 + Ai[at(j, k)] ** 2 for j in range(_N)]
+        best = mags[k]
+        onehot = [jnp.ones_like(best) if j == k else jnp.zeros_like(best)
+                  for j in range(_N)]
+        for j in range(k + 1, _N):
+            better = mags[j] > best
+            for l in range(_N):
+                onehot[l] = jnp.where(better, 0.0, onehot[l])
+            onehot[j] = jnp.where(better, 1.0, onehot[j])
+            best = jnp.where(better, mags[j], best)
+
+        def swap(rows):
+            """rows: list over row index of (1,B); swap row k <-> pivot."""
+            piv = rows[k] * onehot[k]
+            for j in range(k + 1, _N):
+                piv = piv + rows[j] * onehot[j]
+            old_k = rows[k]
+            out = list(rows)
+            out[k] = piv
+            for j in range(k + 1, _N):
+                out[j] = jnp.where(onehot[j] > 0, old_k, rows[j])
+            return out
+
+        # swap the (still-relevant) trailing columns of A and the RHS
+        for col in range(k, _N):
+            rowsr = swap([Ar[at(j, col)] for j in range(_N)])
+            rowsi = swap([Ai[at(j, col)] for j in range(_N)])
+            for j in range(_N):
+                Ar[at(j, col)] = rowsr[j]
+                Ai[at(j, col)] = rowsi[j]
+        br = swap(br)
+        bi = swap(bi)
+
+        # eliminate rows below k
+        den = Ar[at(k, k)] ** 2 + Ai[at(k, k)] ** 2
+        den = jnp.where(den != 0.0, den, 1.0)
+        for j in range(k + 1, _N):
+            fr = (Ar[at(j, k)] * Ar[at(k, k)] + Ai[at(j, k)] * Ai[at(k, k)]) / den
+            fi = (Ai[at(j, k)] * Ar[at(k, k)] - Ar[at(j, k)] * Ai[at(k, k)]) / den
+            for col in range(k, _N):
+                Ar[at(j, col)], Ai[at(j, col)] = (
+                    Ar[at(j, col)] - (fr * Ar[at(k, col)] - fi * Ai[at(k, col)]),
+                    Ai[at(j, col)] - (fr * Ai[at(k, col)] + fi * Ar[at(k, col)]),
+                )
+            br[j], bi[j] = (
+                br[j] - (fr * br[k] - fi * bi[k]),
+                bi[j] - (fr * bi[k] + fi * br[k]),
+            )
+
+    # back substitution
+    xr = [None] * _N
+    xi = [None] * _N
+    for k in range(_N - 1, -1, -1):
+        sr, si = br[k], bi[k]
+        for j in range(k + 1, _N):
+            sr = sr - (Ar[at(k, j)] * xr[j] - Ai[at(k, j)] * xi[j])
+            si = si - (Ar[at(k, j)] * xi[j] + Ai[at(k, j)] * xr[j])
+        den = Ar[at(k, k)] ** 2 + Ai[at(k, k)] ** 2
+        den = jnp.where(den != 0.0, den, 1.0)
+        xr[k] = (sr * Ar[at(k, k)] + si * Ai[at(k, k)]) / den
+        xi[k] = (si * Ar[at(k, k)] - sr * Ai[at(k, k)]) / den
+
+    for i in range(_N):
+        xr_ref[i:i + 1, :] = xr[i]
+        xi_ref[i:i + 1, :] = xi[i]
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _solve_blocked(Zr, Zi, Fr, Fi, block: int, interpret: bool):
+    """(Np, 6, 6)/(Np, 6) padded inputs -> (Np, 6) solution, via the
+    Pallas kernel on (36, block)/(6, block) lane-major tiles."""
+    from jax.experimental import pallas as pl
+
+    Np = Zr.shape[0]
+    grid = Np // block
+    # lane-major layouts: matrix entries in sublanes, systems in lanes
+    zr = Zr.reshape(Np, _N * _N).T           # (36, Np)
+    zi = Zi.reshape(Np, _N * _N).T
+    fr = Fr.T                                 # (6, Np)
+    fi = Fi.T
+    spec_z = pl.BlockSpec((_N * _N, block), lambda g: (0, g))
+    spec_f = pl.BlockSpec((_N, block), lambda g: (0, g))
+    xr, xi = pl.pallas_call(
+        _kernel,
+        grid=(grid,),
+        in_specs=[spec_z, spec_z, spec_f, spec_f],
+        out_specs=[spec_f, spec_f],
+        out_shape=[
+            jax.ShapeDtypeStruct(fr.shape, fr.dtype),
+            jax.ShapeDtypeStruct(fi.shape, fi.dtype),
+        ],
+        interpret=interpret,
+    )(zr, zi, fr, fi)
+    return xr.T, xi.T
+
+
+def solve_cx_pallas(A: Cx, b: Cx, block: int = _BLOCK,
+                    interpret: bool | None = None) -> Cx:
+    """Drop-in for :func:`raft_tpu.core.linalg6.solve_cx` (vector RHS).
+
+    ``A``: (..., 6, 6) Cx, ``b``: (..., 6) Cx — leading axes flatten to
+    the lane dimension and pad to a multiple of ``block``.  ``interpret``
+    defaults to True off-TPU (the Mosaic compiler is TPU-only).
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = A.re.shape[:-2]
+    n_sys = int(np.prod(lead)) if lead else 1
+    if n_sys == 0:
+        return Cx(jnp.zeros(lead + (_N,), dtype=A.re.dtype),
+                  jnp.zeros(lead + (_N,), dtype=A.re.dtype))
+    # shrink the block to the batch (128-lane granularity) so small local
+    # shards — e.g. a frequency-sharded solve's per-device bins — don't
+    # pad up to the full default block
+    block = min(block, -(-n_sys // 128) * 128)
+    pad = (-n_sys) % block
+    Np = n_sys + pad
+
+    def prep(x, shape):
+        x = x.reshape((n_sys,) + shape)
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + shape, dtype=x.dtype)], axis=0)
+        return x
+
+    Zr = prep(A.re, (_N, _N))
+    Zi = prep(A.im, (_N, _N))
+    # padded lanes solve the identity so no 0/0 enters the pipeline
+    if pad:
+        eye = jnp.broadcast_to(jnp.eye(_N, dtype=Zr.dtype), (pad, _N, _N))
+        Zr = Zr.at[n_sys:].set(eye)
+    Fr = prep(b.re, (_N,))
+    Fi = prep(b.im, (_N,))
+    xr, xi = _solve_blocked(Zr, Zi, Fr, Fi, block, interpret)
+    return Cx(xr[:n_sys].reshape(lead + (_N,)),
+              xi[:n_sys].reshape(lead + (_N,)))

@@ -86,6 +86,8 @@ def stage_global(tree, mesh: Mesh, specs):
     def put(x, spec):
         if x is None:
             return None
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return x        # already a global array — staging is idempotent
         x = np.asarray(x)
         sharding = NamedSharding(mesh, spec)
         return jax.make_array_from_callback(

@@ -71,9 +71,14 @@ def impedance(w: Array, M: Array, B: Array, C: Array) -> Cx:
     return Cx(-w2 * M + C, w[..., None, None] * B)
 
 
-def _solve_once(Z0: Cx, w: Array, B_drag: Array, F: Cx) -> Cx:
+def _solve_once(Z0: Cx, w: Array, B_drag: Array, F: Cx,
+                use_pallas: bool = False) -> Cx:
     """One impedance solve with the current drag damping folded in."""
     Z = Z0 + Cx(jnp.zeros_like(Z0.re), w[..., None, None] * B_drag[..., None, :, :])
+    if use_pallas:
+        from raft_tpu.core.pallas6 import solve_cx_pallas
+
+        return solve_cx_pallas(Z, F)
     return solve_cx(Z, F)
 
 
@@ -84,8 +89,6 @@ def _error(Xi: Cx, Xi_last: Cx, tol: float) -> Array:
     return jnp.max(num / den)
 
 
-@partial(jax.jit, static_argnames=("n_iter", "tol", "relax", "method",
-                                   "axis_name", "remat", "history"))
 def solve_dynamics(
     m: MemberSet,
     kin: StripKin,
@@ -131,6 +134,39 @@ def solve_dynamics(
     (raft/raft.py:1536-1539).  Static flag, so the default hot path carries
     no history buffer.
     """
+    # opt-in Pallas kernel for the batched 6x6 solves, forward path only:
+    # the kernel defines no VJP, so the differentiable scan route always
+    # keeps the XLA implementation (see core/pallas6.py).  Read OUTSIDE
+    # the jitted core so the flag participates in the jit cache key —
+    # toggling the env var between calls really switches paths.
+    from raft_tpu.core import pallas6
+
+    use_pallas = pallas6.enabled() and method == "while"
+    return _solve_dynamics_impl(
+        m, kin, wave, env, lin, n_iter=n_iter, tol=tol, relax=relax,
+        method=method, axis_name=axis_name, remat=remat, history=history,
+        use_pallas=use_pallas,
+    )
+
+
+@partial(jax.jit, static_argnames=("n_iter", "tol", "relax", "method",
+                                   "axis_name", "remat", "history",
+                                   "use_pallas"))
+def _solve_dynamics_impl(
+    m: MemberSet,
+    kin: StripKin,
+    wave: WaveState,
+    env: Env,
+    lin: LinearCoeffs,
+    n_iter: int,
+    tol: float,
+    relax: float,
+    method: str,
+    axis_name: str | None,
+    remat: bool,
+    history: bool,
+    use_pallas: bool,
+) -> RAOResult:
     nw = wave.w.shape[-1]
     dtype = lin.C.dtype
 
@@ -141,7 +177,7 @@ def solve_dynamics(
         B_drag, F_drag = linearized_drag(m, kin, Xi_last, wave, env,
                                          axis_name=axis_name)
         F = lin.F + F_drag
-        Xi = _solve_once(Z0, wave.w, B_drag, F)
+        Xi = _solve_once(Z0, wave.w, B_drag, F, use_pallas=use_pallas)
         err = _error(Xi, Xi_last, tol)
         if axis_name is not None:
             err = jax.lax.pmax(err, axis_name)      # global convergence

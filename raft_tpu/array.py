@@ -62,6 +62,16 @@ def stack_fowts(designs: list[dict]):
     return members, rna
 
 
+@jax.jit
+def _moor_solve_batch(sys_b, F_b, C_b):
+    """Equilibrium + stiffness + tensions for a stacked MooringSystem batch:
+    (r6_eq (nT,6), residuals (nT,), C_moor (nT,6,6), tensions (nT,nl))."""
+    r6, res = jax.vmap(solve_equilibrium)(sys_b, F_b, C_b)
+    C = jax.vmap(mooring_stiffness)(sys_b, r6)
+    T = jax.vmap(fairlead_tensions)(sys_b, r6)
+    return r6, res, C, T
+
+
 def _phase_kin(kin: StripKin, ph: Cx) -> StripKin:
     """Multiply node wave kinematics by a per-frequency phase factor (nw,)."""
     ph3 = Cx(ph.re[None, :, None], ph.im[None, :, None])
@@ -293,17 +303,15 @@ class ArrayModel:
                 eig = jax.vmap(solve_eigen)(M_tot, C_tot)
                 est = jax.vmap(diagonal_estimates)(M_tot, C_tot)
             else:
-                from raft_tpu.solve import eigen_with_bem
+                from raft_tpu.solve import eigen_with_bem_batched
 
                 A_w = np.moveaxis(np.asarray(self.bem[0]), -1, 0)  # (nw,6,6)
-                wg = np.asarray(self.w)
-                per_t = [
-                    eigen_with_bem(M_tot[i], C_tot[i], A_w, wg, n_pass=n_pass)
-                    for i in range(self.nT)
-                ]
-                eig = jax.tree.map(lambda *xs: jnp.stack(xs),
-                                   *[e for e, _ in per_t])
-                est = np.stack([s for _, s in per_t])
+                # one compiled call for the whole farm (nT-batched fixed
+                # point) instead of nT sequential host round-trips
+                eig, est = eigen_with_bem_batched(
+                    M_tot, C_tot, jnp.asarray(A_w), jnp.asarray(self.w),
+                    n_pass=n_pass,
+                )
         self.eigen = eig
         fns = np.asarray(eig.fns)                          # (nT, 6)
         self.results["eigen"] = {
@@ -320,30 +328,56 @@ class ArrayModel:
         if self.statics is None:
             self.calcSystemProps()
         s = self.statics
-        r6s, Cs, Ts, res = [], [], [], []
         with phase("array-mooring-equilibrium"):
-            for i, mo in enumerate(self.moor):
-                if mo is None:
-                    r6s.append(jnp.zeros(6))
-                    Cs.append(jnp.zeros((6, 6)))
-                    Ts.append(jnp.zeros(0))
-                    res.append(0.0)
-                    continue
-                F_const = s.W_struc[i] + s.W_hydro[i] + self.f6Ext[i]
-                C_body = s.C_struc[i] + s.C_hydro[i]
-                r6, r = solve_equilibrium(mo, F_const, C_body)
-                r6s.append(r6)
-                Cs.append(mooring_stiffness(mo, r6))
-                Ts.append(fairlead_tensions(mo, r6))
-                res.append(float(r))
-        self.r6_eq = jnp.stack(r6s)
-        self.C_moor = jnp.stack(Cs)
+            if self._moor_batchable():
+                # one compiled call solves every turbine's equilibrium:
+                # stack the per-turbine MooringSystems (identical structure
+                # in a farm) and vmap the Newton solve + stiffness +
+                # tensions over the turbine axis
+                sys_b = jax.tree.map(lambda *xs: jnp.stack(xs), *self.moor)
+                F_b = s.W_struc + s.W_hydro + self.f6Ext
+                C_b = s.C_struc + s.C_hydro
+                r6s, res, Cs, Ts = _moor_solve_batch(sys_b, F_b, C_b)
+                Ts = list(Ts)
+            else:
+                r6s, Cs, Ts, res = [], [], [], []
+                for i, mo in enumerate(self.moor):
+                    if mo is None:
+                        r6s.append(jnp.zeros(6))
+                        Cs.append(jnp.zeros((6, 6)))
+                        Ts.append(jnp.zeros(0))
+                        res.append(0.0)
+                        continue
+                    F_const = s.W_struc[i] + s.W_hydro[i] + self.f6Ext[i]
+                    C_body = s.C_struc[i] + s.C_hydro[i]
+                    r6, r = solve_equilibrium(mo, F_const, C_body)
+                    r6s.append(r6)
+                    Cs.append(mooring_stiffness(mo, r6))
+                    Ts.append(fairlead_tensions(mo, r6))
+                    res.append(float(r))
+                r6s = jnp.stack(r6s)
+                Cs = jnp.stack(Cs)
+        self.r6_eq = r6s
+        self.C_moor = Cs
         self.results["means"] = {
             "platform offset": np.asarray(self.r6_eq),        # (nT, 6)
             "equilibrium residual": np.asarray(res),
             "fairlead tensions": [np.asarray(t) for t in Ts],
         }
         return self
+
+    def _moor_batchable(self) -> bool:
+        """True when every turbine has a mooring system of one shared
+        structure (same line count / treedef), so the equilibrium solve can
+        batch over the turbine axis in a single compiled call."""
+        if not self.moor or any(mo is None for mo in self.moor):
+            return False
+        t0 = jax.tree.structure(self.moor[0])
+        n0 = np.shape(self.moor[0].r_anchor)
+        return all(
+            jax.tree.structure(mo) == t0 and np.shape(mo.r_anchor) == n0
+            for mo in self.moor[1:]
+        )
 
     # ------------------------------------------------------------ dynamics
 

@@ -146,7 +146,8 @@ def wave_kinematics(
     return u, ud, pDyn
 
 
-def spreading_weights(n_dir: int = 7, s: float = 2.0, max_offset: float = None):
+def spreading_weights(n_dir: int = 7, s: float = 2.0,
+                      max_offset: "float | None" = None):
     """Discrete cos^2s directional spreading: (offsets [rad], weights).
 
     D(theta) ∝ cos^2s(theta) over (-pi/2, pi/2) about the mean heading —

@@ -108,6 +108,12 @@ def forward_response(
     Returns the :class:`~raft_tpu.solve.RAOResult`.
     """
     if wave.beta is not None:
+        if jnp.ndim(wave.beta) != 0:
+            raise ValueError(
+                f"forward_response expects a scalar wave.beta, got shape "
+                f"{jnp.shape(wave.beta)}: batched WaveStates go through "
+                f"sweep_sea_states (or vmap forward_response per lane)"
+            )
         env = env.replace(beta=wave.beta)
     exclude = bem is not None
     stat = assemble_statics(members, rna, env)
@@ -430,6 +436,14 @@ def sweep_sea_states(
             )
         else:
             A_h, B_h, F_h = bem
+            if isinstance(F_h, Cx):
+                raise ValueError(
+                    "sweep_sea_states expects the raw host (A[6,6,nw], B, "
+                    "F complex) tuple or the staged heading grid from "
+                    "Model.calcBEM(headings=...), not the stage_bem output "
+                    "(F is a Cx): batched sea states re-stage per case, so "
+                    "pass the pre-staging layout"
+                )
             F_rows = np.broadcast_to(np.asarray(F_h), (B,) + np.shape(F_h))
         A_dev, B_dev, _, _ = _bem_device_layout((A_h, B_h, F_rows[0]))
         Fb = np.moveaxis(np.asarray(F_rows), -1, 1)          # (B,nw,6)

@@ -10,5 +10,6 @@ from raft_tpu.solve.eigen import (  # noqa: F401
     diagonal_estimates,
     dominance_order,
     eigen_with_bem,
+    eigen_with_bem_batched,
     solve_eigen,
 )

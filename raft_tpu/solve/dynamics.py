@@ -138,7 +138,11 @@ def solve_dynamics(
     # the kernel defines no VJP, so the differentiable scan route always
     # keeps the XLA implementation (see core/pallas6.py).  Read OUTSIDE
     # the jitted core so the flag participates in the jit cache key —
-    # toggling the env var between calls really switches paths.
+    # toggling the env var between DIRECT solve_dynamics calls really
+    # switches paths.  Callers that wrap this in their own jit/vmap/
+    # shard_map (sweep_sea_states, forward_response_freq_sharded,
+    # ArrayModel.solveDynamics) capture the flag at their first outer
+    # trace; a later toggle does not retrace those pipelines.
     from raft_tpu.core import pallas6
 
     use_pallas = pallas6.enabled() and method == "while"

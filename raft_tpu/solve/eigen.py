@@ -113,32 +113,55 @@ def eigen_with_bem(M_base, C_tot, A_w, w_grid, n_pass: int = 3):
     """
     import numpy as np
 
-    A_w = np.asarray(A_w)
-    w_grid = np.asarray(w_grid)
-    wns = np.full(6, w_grid[0])
-    solve6 = jax.jit(jax.vmap(solve_eigen, in_axes=(0, None)))
-    for _ in range(n_pass):
-        # A_modes[i] = A(w_n of mode i): one eigen assembly per mode
-        A_modes = np.empty((6, 6, 6))
-        for a in range(6):
-            for b in range(6):
-                A_modes[:, a, b] = np.interp(wns, w_grid, A_w[:, a, b])
-        eigs = solve6(jnp.asarray(M_base + A_modes), C_tot)
-        wns = np.asarray(eigs.wns)[np.arange(6), np.arange(6)]
-    # reduce the 6-assembly batch to one flat per-DOF result so the caller
-    # sees the same shape with or without BEM staged
-    result = EigenResult(
-        fns=jnp.asarray(wns) / _TWO_PI,
-        wns=jnp.asarray(wns),
-        modes=jnp.stack([eigs.modes[i, :, i] for i in range(6)], axis=1),
-        order=jnp.stack([eigs.order[i, i] for i in range(6)]),
+    res, est = eigen_with_bem_batched(
+        jnp.asarray(M_base)[None], jnp.asarray(C_tot)[None],
+        jnp.asarray(A_w), jnp.asarray(w_grid), n_pass=n_pass,
     )
-    est = np.asarray(
-        jax.vmap(diagonal_estimates, in_axes=(0, None))(
-            jnp.asarray(M_base + A_modes), C_tot
+    return jax.tree.map(lambda a: a[0], res), np.asarray(est)[0]
+
+
+@partial(jax.jit, static_argnames=("n_pass",))
+def eigen_with_bem_batched(M_base: Array, C_tot: Array, A_w: Array,
+                           w_grid: Array, n_pass: int = 3):
+    """Pure-jax, turbine-batched :func:`eigen_with_bem`.
+
+    Same per-mode fixed point (interpolate A(w) at each mode's own natural
+    frequency, re-solve, repeat), but compiled end to end and vmapped over
+    a leading turbine axis — one jit call eigen-solves a whole farm instead
+    of ``nT`` sequential host round-trips (the ArrayModel analog of the
+    reference's single 6N block assembly, raft/raft.py:1292-1298).
+
+    ``M_base``/``C_tot``: (nT,6,6); ``A_w``: (nw,6,6) shared BEM added-mass
+    table (one hull design serves the farm); ``w_grid``: (nw,).
+    Returns ``(EigenResult with (nT,6)-shaped fields, estimates (nT,6))``.
+    """
+    if n_pass < 1:
+        raise ValueError(f"eigen_with_bem_batched needs n_pass >= 1, got {n_pass}")
+    A_flat = A_w.reshape(A_w.shape[0], 36)              # (nw, 36)
+
+    def interp_A(wns):                                   # (6,) -> (6,6,6)
+        vals = jax.vmap(lambda col: jnp.interp(wns, w_grid, col),
+                        in_axes=1, out_axes=1)(A_flat)   # (6, 36)
+        return vals.reshape(6, 6, 6)
+
+    def one(M1, C1):                                     # (6,6),(6,6)
+        wns = jnp.full(6, w_grid[0])
+        for _ in range(n_pass):                          # static 2-3 passes
+            A_modes = interp_A(wns)                      # (6,6,6)
+            eigs = jax.vmap(solve_eigen, in_axes=(0, None))(M1 + A_modes, C1)
+            wns = jnp.diagonal(eigs.wns)                 # mode i at assembly i
+        res = EigenResult(
+            fns=wns / _TWO_PI,
+            wns=wns,
+            modes=jnp.stack([eigs.modes[i, :, i] for i in range(6)], axis=1),
+            order=jnp.stack([eigs.order[i, i] for i in range(6)]),
         )
-    )[np.arange(6), np.arange(6)]
-    return result, est
+        est = jnp.diagonal(
+            jax.vmap(diagonal_estimates, in_axes=(0, None))(M1 + A_modes, C1)
+        )
+        return res, est
+
+    return jax.vmap(one)(M_base, C_tot)
 
 
 @partial(jax.jit, static_argnames=("sweeps",))

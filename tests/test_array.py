@@ -312,3 +312,55 @@ def test_model_solvestatics_alias():
     m.solveStatics()
     assert "means" in m.results
     assert 10.0 < m.results["means"]["platform offset"][0] < 40.0
+
+
+def test_farm16_batched_matches_loop(monkeypatch):
+    """Farm-scale array: 16 turbines solve eigen + mooring equilibrium in
+    ONE compiled call each (eigen_with_bem_batched / _moor_solve_batch),
+    and the batched results match the sequential per-turbine loop."""
+    design = load_design(OC3)
+    nw = len(W)
+    A = np.zeros((6, 6, nw))
+    for i in range(6):
+        A[i, i] = 5e6 * (1e3 if i >= 3 else 1.0) / (1 + W**2)
+    B = np.zeros((6, 6, nw))
+    F = np.zeros((6, nw), dtype=complex)
+
+    a = Model(design, w=W, nTurbines=16, BEM=(A, B, F))
+    a.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)
+    a.calcSystemProps()
+    assert a._moor_batchable()          # identical farm -> batched fast path
+    a.solveEigen()
+    a.calcMooringAndOffsets()
+    fa = a.results["eigen"]["frequencies"]
+    r6_b = np.asarray(a.r6_eq)
+    C_b = np.asarray(a.C_moor)
+    T_b = np.stack([np.asarray(t)
+                    for t in a.results["means"]["fairlead tensions"]])
+    assert fa.shape == (16, 6) and r6_b.shape == (16, 6)
+
+    # identical co-located turbines: every row equals row 0
+    for arr in (fa, r6_b, C_b, T_b):
+        np.testing.assert_allclose(arr, np.broadcast_to(arr[:1], arr.shape),
+                                   rtol=1e-6, atol=1e-9)
+
+    # the sequential per-turbine loop gives the same physics
+    a2 = Model(design, w=W, nTurbines=16, BEM=(A, B, F))
+    a2.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)
+    a2.calcSystemProps()
+    monkeypatch.setattr(a2, "_moor_batchable", lambda: False)
+    a2.calcMooringAndOffsets()
+    np.testing.assert_allclose(r6_b, np.asarray(a2.r6_eq),
+                               rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(C_b, np.asarray(a2.C_moor), rtol=1e-5)
+    T_l = np.stack([np.asarray(t)
+                    for t in a2.results["means"]["fairlead tensions"]])
+    np.testing.assert_allclose(T_b, T_l, rtol=1e-6)
+
+    # eigen matches the single-turbine solve with the same staged BEM
+    m1 = Model(design, w=W, BEM=(A, B, F))
+    m1.setEnv(Hs=8.0, Tp=12.0)
+    m1.calcSystemProps()
+    m1.solveEigen()
+    np.testing.assert_allclose(
+        fa[0], m1.results["eigen"]["frequencies"], rtol=1e-6)

@@ -37,7 +37,8 @@ _PROBE_TIMEOUT_S = int(os.environ.get("RAFT_TPU_PROBE_TIMEOUT", "45"))
 _PROBE_RETRIES = int(os.environ.get("RAFT_TPU_PROBE_RETRIES", "2"))
 
 
-def _probe_backend(timeout=_PROBE_TIMEOUT_S, retries=_PROBE_RETRIES):
+def _probe_backend(timeout=_PROBE_TIMEOUT_S, retries=_PROBE_RETRIES,
+                   env=None):
     """Check the pinned JAX backend actually works, WITHOUT risking this
     process: backend init on a remote-tunnel plugin can block indefinitely
     when its service is wedged, so the probe runs one trivial jitted op in a
@@ -59,7 +60,7 @@ def _probe_backend(timeout=_PROBE_TIMEOUT_S, retries=_PROBE_RETRIES):
         try:
             r = subprocess.run(
                 [sys.executable, "-c", code],
-                capture_output=True, text=True, timeout=timeout,
+                capture_output=True, text=True, timeout=timeout, env=env,
             )
             if r.returncode == 0 and r.stdout.strip():
                 return r.stdout.strip().splitlines()[-1], None
@@ -69,12 +70,13 @@ def _probe_backend(timeout=_PROBE_TIMEOUT_S, retries=_PROBE_RETRIES):
                 "detail": (r.stderr.strip() or r.stdout.strip())[-500:],
             }
         except subprocess.TimeoutExpired:
+            probe_env = env if env is not None else os.environ
             err = {
                 "class": "BackendInitTimeout",
                 "detail": f"trivial jitted op did not complete within "
                           f"{timeout}s (attempt {attempt + 1}/{retries}); "
-                          f"backend pinned to "
-                          f"{os.environ.get('JAX_PLATFORMS', '<default>')!r}",
+                          f"probe env pinned to "
+                          f"{probe_env.get('JAX_PLATFORMS', '<default>')!r}",
             }
     return None, err
 
@@ -254,6 +256,52 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
     return out
 
 
+def pallas6_microbench(batch: int = 16384, reps: int = 5):
+    """Pallas vs XLA on the hot op: ``batch`` independent 6x6 complex
+    solves (the RAO engine's inner operation).  Only meaningful on a real
+    TPU (Mosaic is TPU-only; off-TPU the kernel runs interpreted and this
+    measurement is skipped by the caller).  Returns timings + speedup +
+    max-abs cross-check so the kernel's keep/enable/delete decision is a
+    measured one (core/pallas6.py)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.core import linalg6, pallas6
+    from raft_tpu.core.cplx import Cx
+
+    key = jax.random.PRNGKey(0)
+    kr, ki, kb1, kb2 = jax.random.split(key, 4)
+    # diagonally dominant systems: well-conditioned at any batch size
+    Ar = jax.random.normal(kr, (batch, 6, 6)) + 8.0 * jnp.eye(6)
+    Ai = 0.3 * jax.random.normal(ki, (batch, 6, 6))
+    A = Cx(Ar, Ai)
+    b = Cx(jax.random.normal(kb1, (batch, 6)),
+           jax.random.normal(kb2, (batch, 6)))
+    x_fn = jax.jit(linalg6.solve_cx)
+    p_fn = jax.jit(lambda A, b: pallas6.solve_cx_pallas(A, b, interpret=False))
+    xx = x_fn(A, b)
+    xp = p_fn(A, b)
+    err = float(jnp.max(jnp.abs(xx.re - xp.re))
+                + jnp.max(jnp.abs(xx.im - xp.im)))
+
+    def best_of(fn):
+        t_best = np.inf
+        for _ in range(reps):
+            t0 = time.perf_counter()
+            fn(A, b).re.block_until_ready()
+            t_best = min(t_best, time.perf_counter() - t0)
+        return t_best
+
+    t_x, t_p = best_of(x_fn), best_of(p_fn)
+    return {
+        "batch": batch,
+        "xla_s": round(t_x, 6),
+        "pallas_s": round(t_p, 6),
+        "pallas_speedup_vs_xla": round(t_x / t_p, 3),
+        "max_abs_diff": err,
+    }
+
+
 def oc3_strip_throughput(batch: int = 2048, nw: int = 200, reps: int = 3):
     import jax
     import jax.numpy as jnp
@@ -402,6 +450,50 @@ def serial_baseline_oc3(nw: int = 200):
     return _serial_rao(members, rna, wave, env, C_moor, nw=nw)
 
 
+def _retry_device_bench(budget_s: float):
+    """One last chance at a real device number after a CPU fallback: the
+    wedge can clear mid-window, so re-probe the pinned backend and, if it
+    answers, run the FULL bench in a fresh subprocess (this process is
+    already pinned to CPU) under whatever wall-clock budget remains.
+
+    Returns the subprocess's parsed JSON dict on success, else an error
+    dict explaining why the retry did not produce a device number.
+    """
+    if budget_s < 120:
+        return None, {"class": "RetrySkipped",
+                      "detail": f"only {budget_s:.0f}s of bench budget left"}
+    t0 = time.perf_counter()
+    env = dict(os.environ)
+    env.pop("JAX_PLATFORMS", None)          # undo this process's CPU pin
+    platform, probe_err = _probe_backend(retries=1, env=env)
+    if platform in (None, "cpu"):           # cpu = the pin, not the device
+        return None, {"class": "RetryProbeFailed", **(probe_err or {})}
+    env["RAFT_TPU_BENCH_ASSUME_DEVICE"] = "1"
+    # the probe spent part of the remaining budget; the subprocess gets
+    # what is left so the whole bench stays inside the driver wall-clock
+    sub_timeout = budget_s - (time.perf_counter() - t0)
+    if sub_timeout < 60:
+        return None, {"class": "RetrySkipped",
+                      "detail": f"probe left only {sub_timeout:.0f}s"}
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=sub_timeout, env=env,
+        )
+        line = (r.stdout.strip().splitlines() or [""])[-1]
+        out = json.loads(line)
+        if out.get("value") and out.get("platform") not in (None, "cpu"):
+            return out, None
+        return None, {"class": "RetryBenchFailed",
+                      "detail": out.get("error") or line[:500]}
+    except subprocess.TimeoutExpired:
+        return None, {"class": "RetryBenchTimeout",
+                      "detail": f"device bench did not finish in "
+                                f"{sub_timeout:.0f}s"}
+    except Exception as e:
+        return None, {"class": type(e).__name__, "detail": str(e)[-300:]}
+
+
 def main():
     """Probe the backend, run the workloads, print exactly ONE JSON line.
 
@@ -410,11 +502,22 @@ def main():
     falls back to a reduced CPU workload (clearly labeled, with the probe
     error embedded), and any later failure still emits a parseable
     diagnostic JSON line instead of a stack trace — a wedged TPU costs the
-    round a TPU number, not the whole artifact.
+    round a TPU number, not the whole artifact.  Because a wedge can also
+    CLEAR mid-window, a fallback run re-probes the device after the CPU
+    workloads finish and promotes a successful full device bench (in a
+    fresh subprocess) to the primary result.
     """
+    t_start = time.perf_counter()
+    budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET", "1200"))
     metric = "design-freq RAO solves/sec/chip (1k VolturnUS-S x 200w, BEM staged)"
-    platform, probe_err = _probe_backend()
-    fallback = platform is None
+    if os.environ.get("RAFT_TPU_BENCH_ASSUME_DEVICE"):
+        # retry subprocess: the parent probed the backend a moment ago —
+        # run the full device bench directly, no further probing
+        platform, probe_err = "device", None
+        fallback = False
+    else:
+        platform, probe_err = _probe_backend()
+        fallback = platform is None
     if fallback:
         # the pinned backend is unreachable: measure on CPU with reduced
         # batches so the artifact stays inside the driver's time budget.
@@ -437,9 +540,23 @@ def main():
             setup = _volturn_setup()           # shared host-side precompute
         ns = north_star(setup=setup, **ns_kw)
         oc3 = oc3_strip_throughput(**oc3_kw)
+        pallas = None
+        if not fallback and platform not in (None, "cpu"):
+            # measure the hand-written kernel on the hardware it exists
+            # for (a plain-CPU host has no Mosaic — skip, as documented);
+            # a Mosaic failure degrades to a note, never kills the run
+            try:
+                with prof.phase("pallas6_microbench"):
+                    pallas = pallas6_microbench()
+            except Exception as e:
+                pallas = {"error": f"{type(e).__name__}: {str(e)[-300:]}"}
         with prof.phase("serial_baselines"):
             base_v = serial_baseline_volturn(setup=setup)
             base_o = serial_baseline_oc3()
+        if platform == "device":             # resolve the real plugin name
+            import jax
+
+            platform = jax.devices()[0].platform
         value = ns["solves_per_s"]
         out = {
             "metric": metric,
@@ -453,6 +570,7 @@ def main():
                     **oc3,
                     "vs_baseline": round(oc3["solves_per_s"] / base_o, 1),
                 },
+                **({"pallas6_microbench": pallas} if pallas else {}),
             },
             "serial_baseline_solves_per_s": {
                 "volturn_bem": round(base_v, 1),
@@ -466,6 +584,22 @@ def main():
                 "batches; value is NOT a TPU number"
             )
             out["backend_probe_error"] = probe_err
+            # the wedge may have cleared while the CPU workloads ran:
+            # re-probe, and promote a successful full device bench
+            remaining = budget_s - (time.perf_counter() - t_start) - 30
+            dev_out, retry_err = _retry_device_bench(remaining)
+            if dev_out is not None:
+                dev_out["note"] = (
+                    "device recovered mid-window: full bench re-run on the "
+                    "device after an initial CPU fallback"
+                )
+                dev_out["initial_probe_error"] = probe_err
+                dev_out["cpu_fallback_preview"] = {
+                    "value": out["value"], "workloads": out["workloads"],
+                }
+                out = dev_out
+            else:
+                out["tpu_retry"] = retry_err
         print(json.dumps(out))
     except Exception as e:  # emit a diagnostic line, not a stack trace
         print(

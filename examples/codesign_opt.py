@@ -3,8 +3,11 @@
 The WEIS inner loop (BASELINE.json configs[4]): sigma of the nacelle
 fore-aft acceleration, differentiated exactly through statics, Morison
 hydro, and the drag-linearized RAO fixed point, minimized with optax Adam
-under box bounds over TWO geometry parameters at once — hull diameter
-scale and draft stretch (the north star's own sweep axes).
+under box bounds — first over TWO hull parameters (diameter scale and
+draft stretch, the north star's own sweep axes), then over FIVE:
+hull + mooring (line length, anchor radius, axial stiffness EA), the
+mooring stiffness recomputed differentiably through the catenary Newton
+solve each step (raft_tpu.mooring.scale_mooring).
 """
 import os
 
@@ -15,7 +18,7 @@ from raft_tpu.build.members import build_member_set, build_rna
 from raft_tpu.core.types import Env, WaveState
 from raft_tpu.core.waves import jonswap, wave_number
 from raft_tpu.model import load_design
-from raft_tpu.mooring import mooring_stiffness, parse_mooring
+from raft_tpu.mooring import mooring_stiffness, parse_mooring, scale_mooring
 from raft_tpu.parallel import (
     grad_nacelle_accel_std,
     make_stretch_draft,
@@ -64,6 +67,21 @@ def main(steps: int = 10, nw: int = 60):
     print(f"optimized: diam {res.theta[0]:.4f}, draft {res.theta[1]:.4f}, "
           f"sigma_nac {res.objective:.5f} m/s^2 "
           f"({100 * (1 - res.objective / res.history[0]):.1f}% better than stock)")
+
+    # hull + mooring co-design: theta = [diam, draft, L, R, EA]
+    res5 = optimize_design(
+        members, rna, env, wave, None,
+        theta0=jnp.ones(5),
+        apply_fn=lambda m, t: apply2(m, t[:2]),
+        moor=moor, moor_apply_fn=lambda s, t: scale_mooring(s, t[2:5]),
+        steps=steps, learning_rate=0.02,
+        bounds=(0.85 * jnp.ones(5), 1.2 * jnp.ones(5)),
+    )
+    t = res5.theta
+    print(f"hull+mooring: diam {t[0]:.4f} draft {t[1]:.4f} "
+          f"L {t[2]:.4f} R {t[3]:.4f} EA {t[4]:.4f}  "
+          f"sigma_nac {res5.objective:.5f} m/s^2 "
+          f"({100 * (1 - res5.objective / res5.history[0]):.1f}% better)")
 
 
 if __name__ == "__main__":

@@ -11,12 +11,23 @@ one-hot blend (no gathers).  One kernel invocation per block replaces the
 (matrix entries live in sublanes, systems in lanes) so a block's whole
 working set stays in VMEM across all 6 elimination steps.
 
-Status: OFF by default.  Bit-compared against ``linalg6.solve_cx`` in
-interpreter mode by ``tests/test_pallas6.py`` (the only mode available on
-this host — see DEVIATIONS.md); enable on real TPU hardware with
-``RAFT_TPU_PALLAS=1`` once measured.  Forward (inference) path only: the
-kernel defines no VJP, so the differentiable ``method="scan"`` route keeps
-the XLA implementation regardless of the flag.
+Status — the decided position, not a placeholder:
+
+* **Opt-in (``RAFT_TPU_PALLAS=1``), staying opt-in until a hardware
+  number exists.** The kernel is bit-validated against
+  ``linalg6.solve_cx`` in interpreter mode (``tests/test_pallas6.py``)
+  but has never run on a real chip: the TPU tunnel on the build hosts
+  was unreachable through rounds 3-5 (DEVIATIONS.md).  ``bench.py``
+  measures Pallas vs XLA on the hot op automatically whenever its
+  device path runs (``pallas6_microbench``) and records the ratio in
+  the bench JSON — the flip-the-default decision is taken from that
+  number, not from a guess.
+* **No VJP, by design.** The differentiable route (``method="scan"``,
+  used by every gradient/co-design path) always keeps the XLA
+  implementation: a hand-written backward for a 6x6 pivoted solve would
+  duplicate what XLA already fuses well, for zero measured payoff.  The
+  kernel targets the inference-heavy ``method="while"`` sweeps only,
+  and ``solve_dynamics`` enforces exactly that gating.
 """
 from __future__ import annotations
 

@@ -12,6 +12,7 @@ from raft_tpu.mooring.system import (  # noqa: F401
     mooring_force,
     mooring_stiffness,
     parse_mooring,
+    scale_mooring,
     solve_equilibrium,
     tension_jacobian,
 )

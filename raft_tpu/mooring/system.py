@@ -44,6 +44,29 @@ class MooringSystem:
     yaw_stiffness: Array = struct.field(default=0.0)  # additive C[5,5] (raft/raft.py:1264-1268)
 
 
+def scale_mooring(sys: MooringSystem, theta) -> MooringSystem:
+    """Differentiable mooring design knobs: ``theta = (L, R, EA)`` scales.
+
+    * ``theta[0]`` — unstretched line length
+    * ``theta[1]`` — anchor radius (horizontal anchor distance from the
+      platform centerline; water depth unchanged)
+    * ``theta[2]`` — axial stiffness EA
+
+    The standard co-design parameterization over the reference mooring
+    schema (raft/OC3spar.yaml:80-147: line ``length``, anchor point
+    coordinates, line-type ``stiffness``).  All three enter the catenary
+    Newton solve, so responses and stiffnesses differentiate exactly
+    w.r.t. theta (mooring/system.py jacfwd stack).
+    """
+    theta = jnp.asarray(theta)
+    props = sys.props.replace(L=sys.props.L * theta[0],
+                              EA=sys.props.EA * theta[2])
+    r_anchor = jnp.concatenate(
+        [sys.r_anchor[:, :2] * theta[1], sys.r_anchor[:, 2:]], axis=1
+    )
+    return sys.replace(props=props, r_anchor=r_anchor)
+
+
 def parse_mooring(mooring: dict, rho: float = 1025.0, g: float = 9.81,
                   yaw_stiffness: float = 0.0) -> MooringSystem:
     """Build a :class:`MooringSystem` from the design-YAML ``mooring`` dict.

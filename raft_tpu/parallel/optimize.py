@@ -70,8 +70,16 @@ def energy_sum(sigmas):
 
 
 def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
-               n_iter, remat, case_reduce=None):
+               n_iter, remat, case_reduce=None, moor=None,
+               moor_apply_fn=None, r6_moor=None):
     """theta -> objective(Xi) through the reverse-differentiable pipeline.
+
+    With ``moor`` (a :class:`~raft_tpu.mooring.MooringSystem`) and
+    ``moor_apply_fn(moor, theta)`` given, the mooring stiffness is
+    recomputed INSIDE the loss from the theta-modified system —
+    ``C = mooring_stiffness(moor_apply_fn(moor, theta), r6_moor)`` — so
+    line length / anchor radius / EA become differentiable design
+    variables alongside the hull geometry (``C_moor`` is then ignored).
 
     ``wave`` may be a single sea state or a batched WaveState from
     :func:`~raft_tpu.parallel.sweep.make_wave_states` (leading case axis on
@@ -144,7 +152,7 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
                 bem = _stage_zeta(staged, wave.zeta)
                 staged = None
 
-    def solve_one(m, wv, F_re=None, F_im=None):
+    def solve_one(m, C, wv, F_re=None, F_im=None):
         if F_re is not None:
             b = _stage_zeta((staged_F[0], staged_F[1], F_re, F_im), wv.zeta)
         elif staged is not None:
@@ -152,22 +160,31 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
         else:
             b = bem
         out = forward_response(
-            members=m, rna=rna, env=env, wave=wv, C_moor=C_moor,
+            members=m, rna=rna, env=env, wave=wv, C_moor=C,
             bem=b, n_iter=n_iter, method="scan", remat=remat,
         )
         return objective(out.Xi, wv, rna)
 
     def loss(theta):
         m = apply_fn(members, theta)
+        if moor is not None:
+            from raft_tpu.mooring import mooring_stiffness
+
+            sys_t = moor_apply_fn(moor, theta)
+            r0 = (jnp.zeros(6, dtype=sys_t.r_anchor.dtype)
+                  if r6_moor is None else r6_moor)
+            C = mooring_stiffness(sys_t, r0)
+        else:
+            C = C_moor
         if batched:
             if staged_F is not None:
                 per = jax.vmap(
-                    lambda wv, fr, fi: solve_one(m, wv, fr, fi)
+                    lambda wv, fr, fi: solve_one(m, C, wv, fr, fi)
                 )(wave, staged_F[2], staged_F[3])
             else:
-                per = jax.vmap(lambda wv: solve_one(m, wv))(wave)
+                per = jax.vmap(lambda wv: solve_one(m, C, wv))(wave)
             return case_reduce(per)
-        return solve_one(m, wave)
+        return solve_one(m, C, wave)
 
     return loss
 
@@ -197,8 +214,20 @@ def optimize_design(
     n_iter: int = 25,
     remat: bool = False,
     case_reduce=None,
+    moor=None,
+    moor_apply_fn=None,
+    r6_moor=None,
 ) -> OptResult:
     """Minimize a response statistic over a geometry parameterization.
+
+    Co-design over hull AND mooring: pass ``moor`` (the MooringSystem) and
+    ``moor_apply_fn(moor, theta) -> MooringSystem`` (e.g.
+    :func:`raft_tpu.mooring.scale_mooring`, reading its own components of
+    theta) and the mooring stiffness is recomputed differentiably inside
+    the loss at linearization point ``r6_moor`` (default zeros) — line
+    length, anchor radius and EA become gradient knobs next to the
+    geometry scales, closing the WEIS co-design loop over the reference
+    mooring schema (raft/OC3spar.yaml:80-147).
 
     ``wave`` may be a batched WaveState (``make_wave_states``): the
     objective then evaluates per sea-state case and reduces with
@@ -232,7 +261,8 @@ def optimize_design(
         optimizer = optax.adam(learning_rate)
 
     loss = _make_loss(members, rna, env, wave, C_moor, objective, apply_fn,
-                      bem, n_iter, remat, case_reduce=case_reduce)
+                      bem, n_iter, remat, case_reduce=case_reduce,
+                      moor=moor, moor_apply_fn=moor_apply_fn, r6_moor=r6_moor)
     val_grad = jax.jit(jax.value_and_grad(loss))
 
     theta = jnp.asarray(theta0, dtype=float)

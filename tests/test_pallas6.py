@@ -38,6 +38,7 @@ def test_matches_linalg6_including_padding():
                                rtol=0, atol=1e-13)
 
 
+@pytest.mark.slow
 def test_pivot_permutation_exact():
     """A permutation matrix has a zero first pivot: only the lane-wise
     one-hot pivoting path solves it (exactly)."""
@@ -53,6 +54,7 @@ def test_pivot_permutation_exact():
     np.testing.assert_allclose(res, np.asarray(b.to_complex()), atol=1e-15)
 
 
+@pytest.mark.slow
 def test_vmap_composes():
     """The kernel batches under vmap (the design-sweep usage pattern)."""
     A, b = _random_systems(4 * 96, np.random.default_rng(2))
@@ -64,6 +66,7 @@ def test_vmap_composes():
                                np.asarray(x_ref.re), rtol=0, atol=1e-13)
 
 
+@pytest.mark.slow
 def test_solver_flag_switches_while_path_only(monkeypatch):
     """RAFT_TPU_PALLAS=1 routes the while-loop driver's solves through the
     kernel (same answer) — the flag is read outside the jitted core, so

@@ -303,6 +303,7 @@ def test_diagonal_estimates_cg_lever_matches_eigen():
     assert abs(f_naive - f_full[1]) / f_full[1] > abs(est[4] - f_full[1]) / f_full[1]
 
 
+@pytest.mark.slow
 def test_eigen_bem_added_mass_fixed_point():
     """With a strongly frequency-dependent staged A_bem, solveEigen must
     evaluate A at each mode's own natural frequency (self-consistency),
@@ -339,6 +340,7 @@ def test_eigen_bem_added_mass_fixed_point():
         assert abs(np.asarray(out.wns)[i] - wn) / wn < 1e-3
 
 
+@pytest.mark.slow
 def test_remat_gradient_matches():
     """jax.checkpoint on the scan step must not change values or gradients
     (it only trades memory for recompute)."""

@@ -1,0 +1,20 @@
+# Developer entry points. The test suite needs no hardware (virtual CPU
+# mesh via tests/conftest.py); bench probes the pinned device and falls
+# back to a labeled CPU measurement when it is unreachable.
+
+.PHONY: fast test evidence bench dryrun
+
+fast:            ## fast test tier (< 8 min on one core)
+	python -m pytest tests/ -q -m "not slow"
+
+test:            ## full suite (nightly tier, ~35 min on one core)
+	python -m pytest tests/ -q
+
+dryrun:          ## 8-device multi-chip dry run (the driver's check)
+	python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+bench:           ## benchmark; prints one JSON line
+	python bench.py
+
+evidence:        ## fast tier + dryrun + bench -> EVIDENCE.json
+	python -m raft_tpu.evidence

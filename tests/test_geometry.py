@@ -168,6 +168,7 @@ def test_padded_set_grad_finite_and_masks_correct():
     assert got == expect
 
 
+@pytest.mark.slow
 def test_rao_solve_runs_on_warped_geometry(oc3):
     """End-to-end: the warped geometry goes through the full RAO solve and
     deeper draft shifts heave resonance down (longer natural period)."""

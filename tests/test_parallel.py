@@ -61,6 +61,7 @@ def test_sea_state_sweep_matches_loop():
     np.testing.assert_allclose(out["std dev"][1], sig1, rtol=1e-12, atol=1e-14)
 
 
+@pytest.mark.slow
 def test_sea_state_sweep_with_bem_matches_staged_single():
     """The per-case zeta re-staging of BEM excitation inside the vmap must
     equal stage_bem + forward_response case by case."""

@@ -165,7 +165,8 @@ class ArrayModel:
 
     # ---------------------------------------------------------------- env
 
-    def setEnv(self, Hs=8.0, Tp=12.0, V=10.0, beta=0.0, Fthrust=0.0):
+    def setEnv(self, Hs=8.0, Tp=12.0, V=10.0, beta=0.0, Fthrust=0.0,
+               current=0.0, current_heading=0.0, current_exp=0.0):
         # validate BEFORE mutating any state: a heading outside the staged
         # grid must leave the model exactly as it was (cf. Model.setEnv)
         F_beta = None
@@ -175,7 +176,9 @@ class ArrayModel:
             betas_g, F_all_g = self._bem_headings[0], self._bem_headings[1]
             F_beta = interp_heading_excitation(betas_g, F_all_g, float(beta))
         self.env = Env(Hs=float(Hs), Tp=float(Tp), V=float(V), beta=float(beta),
-                       depth=self.depth)
+                       depth=self.depth, current=float(current),
+                       current_heading=float(current_heading),
+                       current_exp=float(current_exp))
         S = jonswap(self.w, Hs, Tp)
         k = wave_number(self.w, self.depth)
         self.wave = WaveState(w=self.w, k=k, zeta=jnp.sqrt(S))
@@ -328,6 +331,14 @@ class ArrayModel:
         if self.statics is None:
             self.calcSystemProps()
         s = self.statics
+        f6Ext = self.f6Ext
+        if float(jnp.abs(self.env.current)) > 0:
+            from raft_tpu.hydro import current_mean_force
+
+            # per-turbine mean current drag (stacked members -> vmap)
+            f6Ext = f6Ext + jax.vmap(current_mean_force, in_axes=(0, None))(
+                self.members, self.env
+            )
         with phase("array-mooring-equilibrium"):
             if self._moor_batchable():
                 # one compiled call solves every turbine's equilibrium:
@@ -335,7 +346,7 @@ class ArrayModel:
                 # in a farm) and vmap the Newton solve + stiffness +
                 # tensions over the turbine axis
                 sys_b = jax.tree.map(lambda *xs: jnp.stack(xs), *self.moor)
-                F_b = s.W_struc + s.W_hydro + self.f6Ext
+                F_b = s.W_struc + s.W_hydro + f6Ext
                 C_b = s.C_struc + s.C_hydro
                 r6s, res, Cs, Ts = _moor_solve_batch(sys_b, F_b, C_b)
                 Ts = list(Ts)
@@ -348,7 +359,7 @@ class ArrayModel:
                         Ts.append(jnp.zeros(0))
                         res.append(0.0)
                         continue
-                    F_const = s.W_struc[i] + s.W_hydro[i] + self.f6Ext[i]
+                    F_const = s.W_struc[i] + s.W_hydro[i] + f6Ext[i]
                     C_body = s.C_struc[i] + s.C_hydro[i]
                     r6, r = solve_equilibrium(mo, F_const, C_body)
                     r6s.append(r6)

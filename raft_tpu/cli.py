@@ -44,6 +44,12 @@ def _add_env_args(p):
     p.add_argument("--wmin", type=float, default=0.05)
     p.add_argument("--wmax", type=float, default=3.0)
     p.add_argument("--dw", type=float, default=0.05)
+    p.add_argument("--current", type=float, default=0.0,
+                   help="surface current speed [m/s]")
+    p.add_argument("--current-heading", type=float, default=0.0,
+                   help="current direction [deg]")
+    p.add_argument("--current-exp", type=float, default=0.0,
+                   help="power-law shear exponent (1/7 typical; 0 uniform)")
 
 
 def _build_pipeline_inputs(args, headings=None):
@@ -70,6 +76,12 @@ def _build_pipeline_inputs(args, headings=None):
         # env.beta must sit inside the staged grid (calcBEM re-stages the
         # current heading's excitation by interpolation)
         env_kw["beta"] = float(np.asarray(headings, dtype=float)[0])
+    if getattr(args, "current", 0.0):
+        env_kw.update(
+            current=args.current,
+            current_heading=np.deg2rad(args.current_heading),
+            current_exp=args.current_exp,
+        )
     model.setEnv(Hs=args.hs, Tp=args.tp, Fthrust=thrust, **env_kw)
     if use_bem:
         # explicit call so the mesh knobs apply with OR without a heading
@@ -285,6 +297,12 @@ def main(argv=None):
     p.add_argument("--beta", type=float, default=0.0, help="wave heading [deg]")
     p.add_argument("--thrust", type=float, default=None,
                    help="rotor thrust [N] (default: design Fthrust)")
+    p.add_argument("--current", type=float, default=0.0,
+                   help="surface current speed [m/s]")
+    p.add_argument("--current-heading", type=float, default=0.0,
+                   help="current direction [deg]")
+    p.add_argument("--current-exp", type=float, default=0.0,
+                   help="power-law shear exponent (1/7 typical; 0 uniform)")
     p.add_argument("--wmin", type=float, default=0.05)
     p.add_argument("--wmax", type=float, default=3.0)
     p.add_argument("--dw", type=float, default=0.05)
@@ -309,7 +327,10 @@ def main(argv=None):
                   BEM="native" if args.bem else None,
                   nTurbines=args.n_turbines)
     model.setEnv(Hs=args.hs, Tp=args.tp, V=args.wind,
-                 beta=np.deg2rad(args.beta), Fthrust=thrust)
+                 beta=np.deg2rad(args.beta), Fthrust=thrust,
+                 current=args.current,
+                 current_heading=np.deg2rad(args.current_heading),
+                 current_exp=args.current_exp)
     if args.bem and args.irr:
         model.calcBEM(irr=True)
     model.calcSystemProps()

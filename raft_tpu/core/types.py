@@ -37,6 +37,13 @@ class Env:
     V: Array = struct.field(default=10.0)        # wind speed [m/s]
     beta: Array = struct.field(default=0.0)      # wave heading [rad]
     depth: Array = struct.field(default=300.0)   # water depth [m]
+    # steady current (beyond the reference, which has no current model):
+    # u_c(z) = current * ((depth + z)/depth)^current_exp, clipped to the
+    # water column — power-law profile, current_exp=0 gives uniform flow,
+    # 1/7 the usual open-ocean shear profile
+    current: Array = struct.field(default=0.0)          # surface speed [m/s]
+    current_heading: Array = struct.field(default=0.0)  # direction [rad]
+    current_exp: Array = struct.field(default=0.0)      # profile exponent [-]
 
 
 @struct.dataclass

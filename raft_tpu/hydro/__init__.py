@@ -15,7 +15,9 @@ from raft_tpu.hydro.mesh import (  # noqa: F401
 )
 from raft_tpu.hydro.strip import (  # noqa: F401
     StripKin,
+    current_mean_force,
     linearized_drag,
+    node_current,
     node_kinematics,
     strip_added_mass,
     strip_excitation,

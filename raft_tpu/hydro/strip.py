@@ -49,6 +49,80 @@ Array = jnp.ndarray
 _SQRT_8_PI = (8.0 / jnp.pi) ** 0.5
 
 
+def node_current(m: MemberSet, env: Env) -> Array:
+    """Per-node steady-current velocity vectors (N,3).
+
+    Power-law shear profile over the water column,
+    ``u_c(z) = current * ((depth + z)/depth)^current_exp`` (clipped to
+    [0, 1] so above-surface nodes see the surface speed — they are masked
+    out of every force downstream), heading in the horizontal plane.
+    Beyond-reference: the reference has no current model at all (its Env
+    carries wind + waves only, raft/raft.py:22-30)."""
+    z = m.node_r[..., 2]
+    frac = jnp.clip((env.depth + z) / env.depth, 0.0, 1.0)
+    u = env.current * frac ** env.current_exp                   # (N,)
+    ch = jnp.asarray(env.current_heading)
+    dirv = jnp.stack([jnp.cos(ch), jnp.sin(ch), jnp.zeros_like(ch)], axis=-1)
+    return u[..., None] * dirv
+
+
+def _gauss_drag_slope(U: Array, sigma: Array) -> Array:
+    """MMSE linearization slope of the quadratic drag ``|X| X`` for
+    ``X ~ N(U, sigma^2)``: ``Cov(|X|X, X)/sigma^2 =
+    2 U erf(U/(sigma sqrt2)) + sqrt(8/pi) sigma exp(-U^2/(2 sigma^2))``.
+
+    The exact Gaussian-closure generalization of the Borgman factor:
+    reduces to ``sqrt(8/pi) sigma`` at U=0 (the reference's stochastic
+    linearization, raft/raft.py:2219-2227) and to ``2|U|`` (steady-flow
+    drag slope) as sigma -> 0.  Double-where guards keep sigma=0 lanes
+    (padded nodes) finite in both passes."""
+    s_safe = jnp.where(sigma > 0, sigma, 1.0)
+    r = U / (s_safe * jnp.sqrt(2.0))
+    slope = (2.0 * U * jax.scipy.special.erf(r)
+             + _SQRT_8_PI * sigma * jnp.exp(-(r**2)))
+    return jnp.where(sigma > 0, slope, 2.0 * jnp.abs(U))
+
+
+def _drag_areas(m: MemberSet):
+    """Per-node drag reference areas (axial-skin, p1, p2, end disk)."""
+    d0, d1 = m.node_ds[..., 0], m.node_ds[..., 1]
+    dls = m.node_dls
+    a_q = jnp.where(m.node_circ, jnp.pi * d0 * dls, 2.0 * (d0 + d1) * dls)
+    a_p1 = d0 * dls
+    a_p2 = jnp.where(m.node_circ, d0 * dls, d1 * dls)
+    a_end = jnp.abs(_end_area_signed(m))
+    return a_q, a_p1, a_p2, a_end
+
+
+def current_mean_force(m: MemberSet, env: Env) -> Array:
+    """Mean 6-DOF drag load of the steady current about the PRP.
+
+    Per submerged node and drag direction d in {axial q, transverse p1,
+    p2, end disk}: ``0.5 rho a_d Cd_d |U_d| U_d`` along the direction
+    unit vector — the sigma=0 closed form of the Gaussian drag moment
+    (the oscillatory part enters the response solve through
+    :func:`linearized_drag`'s mean-flow-aware slope instead).  Feeds the
+    mean-offset equilibrium exactly like wind thrust does."""
+    uc = node_current(m, env)                                   # (N,3)
+    U_q = (uc * m.node_q).sum(-1)
+    U_p1 = (uc * m.node_p1).sum(-1)
+    U_p2 = (uc * m.node_p2).sum(-1)
+    a_q, a_p1, a_p2, a_end = _drag_areas(m)
+    half_rho = 0.5 * env.rho
+
+    def mean_drag(U, a, Cd):
+        return half_rho * a * Cd * jnp.abs(U) * U               # (N,)
+
+    F3 = (
+        (mean_drag(U_q, a_q, m.node_Cd_q)
+         + mean_drag(U_q, a_end, m.node_Cd_end))[..., None] * m.node_q
+        + mean_drag(U_p1, a_p1, m.node_Cd_p1)[..., None] * m.node_p1
+        + mean_drag(U_p2, a_p2, m.node_Cd_p2)[..., None] * m.node_p2
+    )
+    F3 = F3 * _submerged(m).astype(F3.dtype)[..., None]
+    return translate_force_3to6(m.node_r, F3).sum(axis=-2)
+
+
 @struct.dataclass
 class StripKin:
     """Wave kinematics at the strip nodes (precomputed once per sea state)."""
@@ -229,6 +303,12 @@ def linearized_drag(
     the reference's component-weighted convention: the relative-velocity
     spectrum is multiplied elementwise by the direction unit vector and the
     Frobenius norm is taken over (xyz, frequency) (raft/raft.py:2219-2227).
+    With a steady current set (``env.current``), the factor becomes the
+    exact Gaussian MMSE slope about the mean flow
+    (:func:`_gauss_drag_slope`) — identical to Borgman at zero current,
+    ``2|U|`` in the steady-flow limit; the current's MEAN load goes
+    through :func:`current_mean_force` into the offset equilibrium, not
+    into the oscillatory excitation.
 
     ``axis_name``: when the frequency grid is sharded over a mesh axis
     (sequence parallelism inside ``shard_map``), the vRMS spectral moment is
@@ -256,18 +336,21 @@ def linearized_drag(
     vRMS_p1 = vrms(m.node_p1)
     vRMS_p2 = vrms(m.node_p2)
 
-    d0, d1 = m.node_ds[..., 0], m.node_ds[..., 1]
-    dls = m.node_dls
-    a_q = jnp.where(m.node_circ, jnp.pi * d0 * dls, 2.0 * (d0 + d1) * dls)
-    a_p1 = d0 * dls
-    a_p2 = jnp.where(m.node_circ, d0 * dls, d1 * dls)
-    a_end = jnp.abs(_end_area_signed(m))
+    # steady current shifts the linearization point: the Borgman factor
+    # sqrt(8/pi)*sigma generalizes to the exact Gaussian MMSE slope about
+    # the mean flow (identical when env.current == 0)
+    uc = node_current(m, env)
+    U_q = (uc * m.node_q).sum(-1)
+    U_p1 = (uc * m.node_p1).sum(-1)
+    U_p2 = (uc * m.node_p2).sum(-1)
+
+    a_q, a_p1, a_p2, a_end = _drag_areas(m)
 
     half_rho = 0.5 * env.rho
-    Bq = _SQRT_8_PI * vRMS_q * half_rho * a_q * m.node_Cd_q
-    Bp1 = _SQRT_8_PI * vRMS_p1 * half_rho * a_p1 * m.node_Cd_p1
-    Bp2 = _SQRT_8_PI * vRMS_p2 * half_rho * a_p2 * m.node_Cd_p2
-    Bend = _SQRT_8_PI * vRMS_q * half_rho * a_end * m.node_Cd_end
+    Bq = _gauss_drag_slope(U_q, vRMS_q) * half_rho * a_q * m.node_Cd_q
+    Bp1 = _gauss_drag_slope(U_p1, vRMS_p1) * half_rho * a_p1 * m.node_Cd_p1
+    Bp2 = _gauss_drag_slope(U_p2, vRMS_p2) * half_rho * a_p2 * m.node_Cd_p2
+    Bend = _gauss_drag_slope(U_q, vRMS_q) * half_rho * a_end * m.node_Cd_end
 
     qq, p1p1, p2p2 = _direction_mats(m)
     Bmat = (

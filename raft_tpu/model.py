@@ -106,8 +106,13 @@ class Model:
 
     # ---------------------------------------------------------------- env
 
-    def setEnv(self, Hs=8.0, Tp=12.0, V=10.0, beta=0.0, Fthrust=0.0):
-        """Sea state + wind (cf. FOWT.setEnv, raft/raft.py:1804-1832)."""
+    def setEnv(self, Hs=8.0, Tp=12.0, V=10.0, beta=0.0, Fthrust=0.0,
+               current=0.0, current_heading=0.0, current_exp=0.0):
+        """Sea state + wind (cf. FOWT.setEnv, raft/raft.py:1804-1832), plus
+        a steady current (speed / heading / power-law shear exponent) the
+        reference has no model for: it adds a mean drag load to the offset
+        equilibrium and shifts the drag linearization point
+        (hydro/strip.py node_current / current_mean_force)."""
         # validate BEFORE mutating any state: a heading outside the staged
         # grid must leave the model exactly as it was
         F_beta = None
@@ -115,7 +120,9 @@ class Model:
             F_beta = self._heading_excitation(float(beta))
         self.env = Env(
             Hs=float(Hs), Tp=float(Tp), V=float(V), beta=float(beta),
-            depth=self.depth,
+            depth=self.depth, current=float(current),
+            current_heading=float(current_heading),
+            current_exp=float(current_exp),
         )
         S = jonswap(self.w, Hs, Tp)
         self.wave = WaveState(
@@ -259,6 +266,10 @@ class Model:
             return self
         s = self.statics
         F_const = s.W_struc + s.W_hydro + self.f6Ext
+        if float(jnp.abs(self.env.current)) > 0:
+            from raft_tpu.hydro import current_mean_force
+
+            F_const = F_const + current_mean_force(self.members, self.env)
         C_body = s.C_struc + s.C_hydro
         with phase("mooring-equilibrium"):
             self.r6_eq, res = solve_equilibrium(self.moor, F_const, C_body)

@@ -1,0 +1,155 @@
+"""Steady-current loading (beyond-reference: the reference Env is wind +
+waves only, raft/raft.py:22-30).
+
+Oracles:
+  * Monte-Carlo pins on the closed-form Gaussian drag moments: for
+    X ~ N(U, sigma^2), E[|X|X] and the MMSE slope Cov(|X|X, X)/sigma^2
+    match the erf/exp expressions used by hydro/strip.py;
+  * limits: slope(0, sigma) = sqrt(8/pi) sigma (the Borgman factor —
+    zero current reproduces the reference linearization exactly) and
+    slope(U, 0) = 2|U| (steady-flow drag derivative);
+  * analytic mean force on a uniform-current vertical cylinder
+    (0.5 rho Cd d L U^2 surge force, pitch moment from the z-lever);
+  * facade: current pushes the OC3 mean surge offset down-stream and the
+    response still converges.
+"""
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from raft_tpu.core.types import Env
+from raft_tpu.build.members import build_member_set
+from raft_tpu.hydro import current_mean_force, node_current
+from raft_tpu.hydro.strip import _gauss_drag_slope
+
+from tests.test_hydro_strip import cylinder_design
+
+RHO = 1025.0
+
+
+def _mc_moments(U, sigma, n=400_000, seed=7):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(U, sigma, size=n)
+    e_absxx = np.mean(np.abs(x) * x)
+    slope = np.mean(np.abs(x) * x * (x - U)) / sigma**2
+    return e_absxx, slope
+
+
+@pytest.mark.parametrize("U,sigma", [(0.7, 1.3), (2.0, 0.5), (-1.1, 0.9)])
+def test_gauss_moments_match_monte_carlo(U, sigma):
+    from math import erf, exp, pi, sqrt
+
+    mc_m, mc_b = _mc_moments(U, sigma)
+    r = U / (sigma * sqrt(2.0))
+    m = (U**2 + sigma**2) * erf(r) + U * sigma * sqrt(2.0 / pi) * exp(-(r**2))
+    b = float(_gauss_drag_slope(jnp.asarray(U), jnp.asarray(sigma)))
+    assert m == pytest.approx(mc_m, rel=2e-2, abs=2e-2)
+    assert b == pytest.approx(mc_b, rel=2e-2)
+
+
+def test_slope_limits():
+    # zero current: exactly the Borgman sqrt(8/pi) sigma factor
+    s = 1.7
+    assert float(_gauss_drag_slope(jnp.asarray(0.0), jnp.asarray(s))) == (
+        pytest.approx(np.sqrt(8.0 / np.pi) * s, rel=1e-12))
+    # steady-flow limit: d(|U|U)/dU = 2|U|; sigma=0 lane stays finite
+    assert float(_gauss_drag_slope(jnp.asarray(-3.0), jnp.asarray(0.0))) == (
+        pytest.approx(6.0, rel=1e-12))
+    # large-U/sigma ratio converges to the same limit smoothly
+    assert float(_gauss_drag_slope(jnp.asarray(3.0), jnp.asarray(1e-3))) == (
+        pytest.approx(6.0, rel=1e-4))
+
+
+def test_profile_and_projection():
+    m = build_member_set(cylinder_design(z0=-100.0, z1=10.0))
+    depth = 200.0
+    # uniform profile: every submerged node sees the surface speed
+    env = Env(depth=depth, current=1.5, current_heading=0.0, current_exp=0.0)
+    uc = np.asarray(node_current(m, env))
+    wet = np.asarray(m.node_r[:, 2]) <= 0
+    assert np.allclose(uc[wet, 0], 1.5)
+    assert np.allclose(uc[:, 1:], 0.0)
+    # sheared profile decays toward the seabed with the power law
+    env7 = env.replace(current_exp=1.0 / 7.0)
+    uc7 = np.asarray(node_current(m, env7))
+    z = np.asarray(m.node_r[:, 2])
+    expect = 1.5 * np.clip((depth + z) / depth, 0.0, 1.0) ** (1.0 / 7.0)
+    assert np.allclose(uc7[:, 0], expect, rtol=1e-6)
+    # heading rotates the vector in plan
+    env_y = env.replace(current_heading=np.pi / 2.0)
+    ucy = np.asarray(node_current(m, env_y))
+    assert np.allclose(ucy[wet, 1], 1.5) and np.allclose(ucy[:, 0], 0.0, atol=1e-7)
+
+
+def test_mean_force_vertical_cylinder_analytic():
+    d, z0, Cd, U = 10.0, -80.0, 0.8, 1.5
+    m = build_member_set(cylinder_design(d=d, z0=z0, z1=20.0, Cd=Cd))
+    env = Env(depth=200.0, current=U, current_heading=0.0, current_exp=0.0)
+    F6 = np.asarray(current_mean_force(m, env))
+    # surge: 0.5 rho Cd d L U^2 over the submerged length (transverse
+    # drag only -- the axial q direction is vertical, orthogonal to the
+    # flow, and end-disk drag acts axially too)
+    L = abs(z0)
+    Fx = 0.5 * RHO * Cd * d * L * U**2
+    assert F6[0] == pytest.approx(Fx, rel=2e-2)          # node discretization
+    assert abs(F6[1]) < 1e-6 * Fx and abs(F6[2]) < 1e-6 * Fx
+    # pitch about the PRP (z=0): -0.5 rho Cd d U^2 * integral z dz
+    My = 0.5 * RHO * Cd * d * U**2 * (z0**2 / 2.0)
+    assert F6[4] == pytest.approx(-My, rel=2e-2)
+    # quadratic in U, odd in sign
+    F6_2 = np.asarray(current_mean_force(m, env.replace(current=2 * U)))
+    assert F6_2[0] == pytest.approx(4.0 * F6[0], rel=1e-6)
+    F6_m = np.asarray(current_mean_force(m, env.replace(current=-U)))
+    assert F6_m[0] == pytest.approx(-F6[0], rel=1e-6)
+
+
+@pytest.mark.slow
+def test_oc3_current_shifts_offset_and_converges():
+    from raft_tpu.model import Model, load_design
+
+    W = np.arange(0.05, 3.0, 0.25)
+    base = Model(load_design("raft_tpu/designs/OC3spar.yaml"), w=W)
+    base.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3)
+    base.calcSystemProps()
+    base.calcMooringAndOffsets()
+    x0 = float(base.r6_eq[0])
+
+    cur = Model(load_design("raft_tpu/designs/OC3spar.yaml"), w=W)
+    cur.setEnv(Hs=8.0, Tp=12.0, Fthrust=800e3,
+               current=1.5, current_heading=0.0, current_exp=1.0 / 7.0)
+    cur.calcSystemProps()
+    cur.calcMooringAndOffsets()
+    x1 = float(cur.r6_eq[0])
+    assert x1 > x0 + 0.5          # down-stream surge grows by metres-ish
+    cur.solveDynamics()
+    assert cur.results["response"]["converged"]
+    assert np.isfinite(cur.results["response"]["std dev"]).all()
+
+    # the mean-flow-aware linearization adds damping: surge response std
+    # does not grow when a strong collinear current is switched on
+    base.solveDynamics()
+    s0 = base.results["response"]["std dev"][0]
+    s1 = cur.results["response"]["std dev"][0]
+    assert s1 <= s0 * 1.05
+
+
+@pytest.mark.slow
+def test_array_current_matches_single():
+    from raft_tpu.model import Model, load_design
+
+    W = np.arange(0.05, 3.0, 0.25)
+    kw = dict(Hs=8.0, Tp=12.0, Fthrust=800e3,
+              current=1.2, current_heading=0.3, current_exp=1.0 / 7.0)
+    m1 = Model(load_design("raft_tpu/designs/OC3spar.yaml"), w=W)
+    m1.setEnv(**kw)
+    m1.calcSystemProps()
+    m1.calcMooringAndOffsets()
+
+    a = Model(load_design("raft_tpu/designs/OC3spar.yaml"), w=W, nTurbines=2)
+    a.setEnv(**kw)
+    a.calcSystemProps()
+    a.calcMooringAndOffsets()
+    ra = np.asarray(a.r6_eq)
+    np.testing.assert_allclose(ra[0], np.asarray(m1.r6_eq),
+                               rtol=1e-6, atol=1e-8)
+    np.testing.assert_allclose(ra[1], ra[0], rtol=1e-8, atol=1e-10)

@@ -232,6 +232,8 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
             t0 = time.perf_counter()
             run_all()
             best = min(best, time.perf_counter() - t0)
+    from raft_tpu.core import pallas6
+
     out = {
         "batch": batch,
         "nw": nw,
@@ -242,6 +244,9 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
         "converged_lanes": n_conv,
         "max_iterations": iters,
         "target_s": 60.0,
+        # which solve path this artifact measured (the kernel is auto-on
+        # on TPU since round 5) — cross-round comparisons need this
+        "pallas_active": pallas6.enabled(),
     }
     if flops_chunk is not None:
         # achieved FLOP/s over the whole batch: XLA's static per-chunk
@@ -336,11 +341,14 @@ def oc3_strip_throughput(batch: int = 2048, nw: int = 200, reps: int = 3):
         o, _ = fwd(scales)
         o.block_until_ready()
         best = min(best, time.perf_counter() - t0)
+    from raft_tpu.core import pallas6
+
     return {
         "batch": batch,
         "nw": nw,
         "wallclock_s": round(best, 4),
         "solves_per_s": round(batch * nw / best, 1),
+        "pallas_active": pallas6.enabled(),
     }
 
 

@@ -24,12 +24,16 @@ Status — the decided position, taken from hardware measurements:
   that dominates the whole solve, which the kernel's lane-wise blends
   avoid entirely.  The kernel is additionally bit-validated against
   ``linalg6.solve_cx`` in interpreter mode (``tests/test_pallas6.py``).
-* **No VJP, by design.** The differentiable route (``method="scan"``,
-  used by every gradient/co-design path) always keeps the XLA
-  implementation: a hand-written backward for a 6x6 pivoted solve would
-  duplicate what XLA already fuses well, for zero measured payoff.  The
-  kernel targets the inference-heavy ``method="while"`` sweeps only,
-  and ``solve_dynamics`` enforces exactly that gating.
+* **Analytic adjoint, not a differentiated kernel.** The
+  differentiable route (``method="scan"``, used by every
+  gradient/co-design path) goes through :func:`solve_cx_pallas_ad`,
+  whose ``custom_vjp`` solves the adjoint system ``A^H lam = xbar``
+  with the SAME forward kernel — one extra kernel call plus an outer
+  product per backward step, no hand-differentiated elimination.  (The
+  earlier rounds' "no VJP" position was premised on the XLA path being
+  fast; the measured 18x reversed that premise.)  Forward-mode
+  ``jvp``/``jacfwd`` is the one transform the wrapper cannot carry —
+  ``RAFT_TPU_PALLAS=0`` keeps the fully transformable XLA path for it.
 """
 from __future__ import annotations
 
@@ -224,3 +228,50 @@ def solve_cx_pallas(A: Cx, b: Cx, block: int = _BLOCK,
     xr, xi = _solve_blocked(Zr, Zi, Fr, Fi, block, interpret)
     return Cx(xr[:n_sys].reshape(lead + (_N,)),
               xi[:n_sys].reshape(lead + (_N,)))
+
+
+@jax.custom_vjp
+def solve_cx_pallas_ad(A: Cx, b: Cx) -> Cx:
+    """:func:`solve_cx_pallas` with an analytic reverse-mode rule.
+
+    The VJP of a linear solve ``x = A^-1 b`` needs no differentiation of
+    the elimination itself: given the cotangent ``xbar``, solve the
+    adjoint system ``A^H lam = xbar`` (ONE more call of the same kernel
+    on the conjugate transpose), then ``bbar = lam`` and
+    ``Abar = -conj(lam) x^T`` (an outer product).  This is what makes the
+    kernel usable on the differentiable ``method="scan"`` fixed point —
+    the backward pass costs one extra kernel call per iteration instead
+    of falling back to the gather-bound XLA lowering that motivated the
+    kernel in the first place.
+
+    In the (re, im)-pair representation the real-valued cotangent algebra
+    works out to (derivation: ``<xbar, dx>_R = Re(xbar^H A^-1 (db - dA x))``):
+
+    * ``lam = (A^H)^-1 xbar``, carried as the pair ``(Re lam, Im lam)``;
+    * ``bbar = (Re lam, Im lam)``;
+    * ``Abar_ij = (-Re(conj(lam_i) x_j), +Im(conj(lam_i) x_j))``.
+
+    Forward-mode (``jvp``/``jacfwd``) is NOT supported through this
+    wrapper (a ``custom_vjp`` limitation) — ``RAFT_TPU_PALLAS=0`` keeps
+    the fully transformable XLA path for that.
+    """
+    return solve_cx_pallas(A, b)
+
+
+def _solve_ad_fwd(A: Cx, b: Cx):
+    x = solve_cx_pallas(A, b)
+    return x, (A, x)
+
+
+def _solve_ad_bwd(res, xbar: Cx):
+    A, x = res
+    AH = Cx(jnp.swapaxes(A.re, -1, -2), -jnp.swapaxes(A.im, -1, -2))
+    lam = solve_cx_pallas(AH, xbar)
+    # conj(lam_i) * x_j, expanded over the trailing (6, 6) matrix axes
+    lr, li = lam.re[..., :, None], lam.im[..., :, None]
+    xr, xi = x.re[..., None, :], x.im[..., None, :]
+    Abar = Cx(-(lr * xr + li * xi), lr * xi - li * xr)
+    return Abar, Cx(lam.re, lam.im)
+
+
+solve_cx_pallas_ad.defvjp(_solve_ad_fwd, _solve_ad_bwd)

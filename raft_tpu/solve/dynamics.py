@@ -72,13 +72,20 @@ def impedance(w: Array, M: Array, B: Array, C: Array) -> Cx:
 
 
 def _solve_once(Z0: Cx, w: Array, B_drag: Array, F: Cx,
-                use_pallas: bool = False) -> Cx:
-    """One impedance solve with the current drag damping folded in."""
+                use_pallas: bool = False, differentiable: bool = False) -> Cx:
+    """One impedance solve with the current drag damping folded in.
+
+    ``differentiable`` picks the kernel variant with the analytic adjoint
+    rule (``solve_cx_pallas_ad``) so reverse-mode AD works through the
+    scan driver; the while driver keeps the plain kernel (a while_loop is
+    not reverse-differentiable anyway, and the plain variant still admits
+    whatever forward transforms the underlying pallas_call does).
+    """
     Z = Z0 + Cx(jnp.zeros_like(Z0.re), w[..., None, None] * B_drag[..., None, :, :])
     if use_pallas:
-        from raft_tpu.core.pallas6 import solve_cx_pallas
+        from raft_tpu.core.pallas6 import solve_cx_pallas, solve_cx_pallas_ad
 
-        return solve_cx_pallas(Z, F)
+        return (solve_cx_pallas_ad if differentiable else solve_cx_pallas)(Z, F)
     return solve_cx(Z, F)
 
 
@@ -135,18 +142,19 @@ def solve_dynamics(
     no history buffer.
     """
     # Pallas kernel for the batched 6x6 solves (auto-on on TPU, where it
-    # is measured 18x faster end-to-end — core/pallas6.py), forward only:
-    # the kernel defines no VJP, so the differentiable scan route always
-    # keeps the XLA implementation (see core/pallas6.py).  Read OUTSIDE
-    # the jitted core so the flag participates in the jit cache key —
-    # toggling the env var between DIRECT solve_dynamics calls really
-    # switches paths.  Callers that wrap this in their own jit/vmap/
-    # shard_map (sweep_sea_states, forward_response_freq_sharded,
+    # is measured 18x faster end-to-end — core/pallas6.py), both drivers:
+    # the while route uses the plain kernel, the scan route the
+    # custom_vjp variant whose analytic adjoint re-uses the same kernel
+    # (forward-mode jvp/jacfwd through scan needs RAFT_TPU_PALLAS=0).
+    # Read OUTSIDE the jitted core so the flag participates in the jit
+    # cache key — toggling the env var between DIRECT solve_dynamics
+    # calls really switches paths.  Callers that wrap this in their own
+    # jit/vmap/shard_map (sweep_sea_states, forward_response_freq_sharded,
     # ArrayModel.solveDynamics) capture the flag at their first outer
     # trace; a later toggle does not retrace those pipelines.
     from raft_tpu.core import pallas6
 
-    use_pallas = pallas6.enabled() and method == "while"
+    use_pallas = pallas6.enabled()
     return _solve_dynamics_impl(
         m, kin, wave, env, lin, n_iter=n_iter, tol=tol, relax=relax,
         method=method, axis_name=axis_name, remat=remat, history=history,
@@ -182,7 +190,8 @@ def _solve_dynamics_impl(
         B_drag, F_drag = linearized_drag(m, kin, Xi_last, wave, env,
                                          axis_name=axis_name)
         F = lin.F + F_drag
-        Xi = _solve_once(Z0, wave.w, B_drag, F, use_pallas=use_pallas)
+        Xi = _solve_once(Z0, wave.w, B_drag, F, use_pallas=use_pallas,
+                         differentiable=(method == "scan"))
         err = _error(Xi, Xi_last, tol)
         if axis_name is not None:
             err = jax.lax.pmax(err, axis_name)      # global convergence

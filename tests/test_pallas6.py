@@ -17,7 +17,7 @@ import jax.numpy as jnp
 
 from raft_tpu.core.cplx import Cx
 from raft_tpu.core.linalg6 import solve_cx
-from raft_tpu.core.pallas6 import solve_cx_pallas
+from raft_tpu.core.pallas6 import solve_cx_pallas, solve_cx_pallas_ad
 
 
 def _random_systems(B, rng):
@@ -69,12 +69,57 @@ def test_vmap_composes():
                                np.asarray(x_ref.re), rtol=0, atol=1e-13)
 
 
+def test_adjoint_grad_matches_xla():
+    """Reverse-mode through ``solve_cx_pallas_ad`` (the analytic
+    ``A^H lam = xbar`` adjoint rule) must equal reverse-mode through the
+    XLA elimination itself, for BOTH the matrix and RHS cotangents.  The
+    loss weights re and im asymmetrically so a conjugation or re/im swap
+    in the hand-derived pair algebra cannot cancel out."""
+    A, b = _random_systems(96, np.random.default_rng(3))
+
+    def make_loss(solver):
+        def loss(A, b):
+            x = solver(A, b)
+            return jnp.sum(x.re ** 2 + 0.7 * x.im ** 2 + 0.3 * x.re * x.im)
+        return loss
+
+    gA_p, gb_p = jax.grad(make_loss(solve_cx_pallas_ad), argnums=(0, 1))(A, b)
+    gA_r, gb_r = jax.grad(make_loss(solve_cx), argnums=(0, 1))(A, b)
+    for got, ref in ((gA_p.re, gA_r.re), (gA_p.im, gA_r.im),
+                     (gb_p.re, gb_r.re), (gb_p.im, gb_r.im)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-9, atol=1e-11)
+
+
 @pytest.mark.slow
-def test_solver_flag_switches_while_path_only(monkeypatch):
+def test_scan_grad_pallas_matches_xla(monkeypatch):
+    """The full differentiable fixed point (``method="scan"``) produces
+    the same gradient with the Pallas path (custom_vjp adjoint inside
+    every scan step, through the remat wrapper) as with the XLA path."""
+    from test_solve import setup
+    from raft_tpu.solve import solve_dynamics
+
+    m, kin, wave, env, lin = setup()
+
+    def loss(scale):
+        lin2 = lin.replace(F=Cx(lin.F.re * scale, lin.F.im * scale))
+        o = solve_dynamics(m, kin, wave, env, lin2, method="scan")
+        return jnp.sum(o.Xi.abs2())
+
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "0")
+    g_xla = float(jax.grad(loss)(jnp.asarray(1.0)))
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "1")
+    g_pal = float(jax.grad(loss)(jnp.asarray(1.0)))
+    assert np.isfinite(g_pal)
+    np.testing.assert_allclose(g_pal, g_xla, rtol=1e-8)
+
+
+@pytest.mark.slow
+def test_solver_flag_switches_both_drivers(monkeypatch):
     """RAFT_TPU_PALLAS=1 routes the while-loop driver's solves through the
     kernel (same answer) — the flag is read outside the jitted core, so
     toggling it mid-process takes effect without any cache clearing; the
-    differentiable scan driver keeps XLA, so gradients still flow."""
+    scan driver's gradients flow through the kernel's adjoint rule."""
     from test_solve import setup
     from raft_tpu.solve import solve_dynamics
 

@@ -55,9 +55,12 @@ _BLOCK = 512          # systems per kernel invocation (lanes: 4 x 128)
 def enabled() -> bool:
     """True when the Pallas solve path should be used.
 
-    ``RAFT_TPU_PALLAS=1`` forces it on (any backend), ``=0`` forces it
-    off; unset means **auto: on exactly when the default backend is a
-    TPU**.  The auto-on default is a measured decision, not a guess: on
+    ``RAFT_TPU_PALLAS=1``/``true``/``on``/``yes`` forces it on (any
+    backend), ``=0``/``false``/``off``/``no`` forces it off; unset,
+    empty, or unrecognized (warned once) means **auto: on exactly when
+    the default backend is a TPU** — so a malformed value degrades to
+    the measured default instead of silently opting out of the 18x
+    TPU path.  The auto-on default is a measured decision, not a guess: on
     a TPU v5e the kernel ran the full 1,000-design north star 18x
     faster than the XLA lowering of the same unrolled solve (0.16 s vs
     2.9 s end-to-end, identical iteration counts, |dXi| ~ 5e-7 — the
@@ -67,8 +70,19 @@ def enabled() -> bool:
     auto stays off there and the tests' pinned-CPU runs are unaffected.
     """
     knob = os.environ.get("RAFT_TPU_PALLAS")
-    if knob is not None:
-        return knob == "1"
+    if knob:
+        k = knob.strip().lower()
+        if k in ("1", "true", "on", "yes"):
+            return True
+        if k in ("0", "false", "off", "no"):
+            return False
+        import warnings
+
+        warnings.warn(
+            f"RAFT_TPU_PALLAS={knob!r} not recognized (use 1/0); "
+            f"falling back to auto (on iff the default backend is TPU)",
+            stacklevel=2,
+        )
     try:
         return jax.default_backend() == "tpu"
     except Exception:  # backend init failure: the XLA path always works

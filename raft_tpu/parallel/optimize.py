@@ -34,6 +34,7 @@ from raft_tpu.core.cplx import Cx
 from raft_tpu.core.types import Env, MemberSet, RNA, WaveState
 from raft_tpu.parallel.sweep import (
     _bem_device_layout,
+    _stage_heading_rows,
     _stage_zeta,
     forward_response,
     scale_diameters,
@@ -108,9 +109,6 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
     staged_F = None     # per-lane heading-interpolated excitation
     if bem is not None:
         if len(bem) == 4:                     # staged heading grid
-            from raft_tpu.model import interp_heading_excitation
-
-            bgrid, F_all, A_h, B_h = bem
             if batched:
                 B_case = int(wave.zeta.shape[0])
                 betas_eval = (np.asarray(wave.beta) if wave.beta is not None
@@ -119,19 +117,12 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
                 betas_eval = np.asarray([
                     float(env.beta) if wave.beta is None else float(wave.beta)
                 ])
-            F_rows = np.stack([
-                interp_heading_excitation(np.asarray(bgrid), F_all, float(b))
-                for b in betas_eval
-            ])                                # (B,6,nw) complex
-            A_dev, B_dev, _, _ = _bem_device_layout((A_h, B_h, F_rows[0]))
-            Fb = np.moveaxis(F_rows, -1, 1)   # (B,nw,6)
+            A_dev, B_dev, F_re, F_im = _stage_heading_rows(bem, betas_eval)
             if batched:
-                staged_F = (A_dev, B_dev,
-                            jnp.asarray(Fb.real), jnp.asarray(Fb.imag))
+                staged_F = (A_dev, B_dev, F_re, F_im)
             else:
-                bem = _stage_zeta(
-                    (A_dev, B_dev, jnp.asarray(Fb.real[0]),
-                     jnp.asarray(Fb.imag[0])), wave.zeta)
+                bem = _stage_zeta((A_dev, B_dev, F_re[0], F_im[0]),
+                                  wave.zeta)
         elif isinstance(bem[2], Cx):          # stage_bem output
             if batched:
                 raise ValueError(

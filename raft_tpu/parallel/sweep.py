@@ -59,6 +59,31 @@ def _bem_device_layout(bem):
     return A, B, jnp.asarray(Fb.real), jnp.asarray(Fb.imag)
 
 
+def _stage_heading_rows(bem, betas_eval):
+    """Stage a ``Model.calcBEM(headings=...)`` heading GRID for a batch of
+    per-case headings: interpolate the excitation to each case's heading on
+    the host, then lay out everything frequency-leading on device.
+
+    ``bem``: the staged grid (betas_grid, F_all[nb,6,nw], A[6,6,nw],
+    B[6,6,nw]); ``betas_eval``: (B,) evaluation headings [rad].  Returns
+    ``(A[nw,6,6], B[nw,6,6], F_re[B,nw,6], F_im[B,nw,6])`` — excitation NOT
+    yet zeta-scaled.  The ONE staging convention shared by
+    :func:`sweep_sea_states` and the co-design losses
+    (:func:`raft_tpu.parallel.optimize.optimize_design`), so the heading
+    interpolation rule cannot drift between the two call sites.
+    """
+    from raft_tpu.model import interp_heading_excitation
+
+    bgrid, F_all, A_h, B_h = bem
+    F_rows = np.stack([
+        interp_heading_excitation(np.asarray(bgrid), F_all, float(b))
+        for b in np.asarray(betas_eval)
+    ])                                       # (B,6,nw) complex
+    A_dev, B_dev, _, _ = _bem_device_layout((A_h, B_h, F_rows[0]))
+    Fb = np.moveaxis(F_rows, -1, 1)          # (B,nw,6)
+    return A_dev, B_dev, jnp.asarray(Fb.real), jnp.asarray(Fb.imag)
+
+
 def _stage_zeta(staged, zeta):
     """Scale device-layout BEM excitation onto the spectral-amplitude basis
     (zeta = sqrt(S)) used by the Morison path.  Traceable — ``zeta`` may be
@@ -415,18 +440,14 @@ def sweep_sea_states(
     # pre-convert the coefficient layout once on host so the vmapped body
     # is pure jnp: per-case excitation (heading interpolation) and the zeta
     # scaling (the only sea-state-dependent parts) happen per case lane
-    staged = None
+    staged = None        # (A[nw,6,6], B[nw,6,6]) device coefficient layout
+    F_ax = None          # vmap axis of the excitation args (0 = per case)
     if bem is not None:
         if len(bem) == 4:                    # staged heading grid
-            from raft_tpu.model import interp_heading_excitation
-
-            bgrid, F_all, A_h, B_h = bem
             betas_eval = (betas_case if betas_case is not None
                           else np.full(B, float(env.beta)))
-            F_rows = np.stack([
-                interp_heading_excitation(np.asarray(bgrid), F_all, float(b))
-                for b in betas_eval
-            ])                               # (B,6,nw) complex
+            A_dev, B_dev, F_re_h, F_im_h = _stage_heading_rows(bem, betas_eval)
+            F_ax = 0                         # (B,nw,6) per-case excitation
         elif betas_case is not None:
             raise ValueError(
                 "cases vary the wave heading but bem is a single-heading "
@@ -435,8 +456,7 @@ def sweep_sea_states(
                 "each case gets its own BEM excitation"
             )
         else:
-            A_h, B_h, F_h = bem
-            if isinstance(F_h, Cx):
+            if isinstance(bem[2], Cx):
                 raise ValueError(
                     "sweep_sea_states expects the raw host (A[6,6,nw], B, "
                     "F complex) tuple or the staged heading grid from "
@@ -444,10 +464,11 @@ def sweep_sea_states(
                     "(F is a Cx): batched sea states re-stage per case, so "
                     "pass the pre-staging layout"
                 )
-            F_rows = np.broadcast_to(np.asarray(F_h), (B,) + np.shape(F_h))
-        A_dev, B_dev, _, _ = _bem_device_layout((A_h, B_h, F_rows[0]))
-        Fb = np.moveaxis(np.asarray(F_rows), -1, 1)          # (B,nw,6)
-        staged = (A_dev, B_dev, jnp.asarray(Fb.real), jnp.asarray(Fb.imag))
+            # one shared heading: stage the excitation ONCE, (nw,6), and
+            # broadcast it per lane via vmap in_axes=None — not B device
+            # copies (only the zeta scaling differs per case)
+            A_dev, B_dev, F_re_h, F_im_h = _bem_device_layout(bem)
+        staged = (A_dev, B_dev)
 
     from raft_tpu.parallel.optimize import nacelle_accel_std
 
@@ -459,9 +480,9 @@ def sweep_sea_states(
                                n_iter=n_iter)
         return out.Xi.abs2(), nacelle_accel_std(out.Xi, wave, rna), out.n_iter
 
-    # dummy per-case excitation keeps one vmap signature when bem is None
-    F_re = staged[2] if staged is not None else jnp.zeros((B, 1))
-    F_im = staged[3] if staged is not None else jnp.zeros((B, 1))
+    # dummy excitation keeps one signature when bem is None
+    F_re = F_re_h if staged is not None else jnp.zeros(())
+    F_im = F_im_h if staged is not None else jnp.zeros(())
     if mesh is not None:
         if mesh.devices.ndim != 1:
             raise ValueError(f"sweep_sea_states expects a 1-D mesh; got "
@@ -470,9 +491,11 @@ def sweep_sea_states(
         if B % n_dev != 0:
             raise ValueError(f"{B} sea states not divisible by {n_dev} devices")
         sharding = NamedSharding(mesh, P(mesh.axis_names[0]))
-        fn = jax.jit(jax.vmap(one), in_shardings=(sharding,) * 3)
+        f_shard = sharding if F_ax == 0 else NamedSharding(mesh, P())
+        fn = jax.jit(jax.vmap(one, in_axes=(0, F_ax, F_ax)),
+                     in_shardings=(sharding, f_shard, f_shard))
     else:
-        fn = jax.jit(jax.vmap(one))
+        fn = jax.jit(jax.vmap(one, in_axes=(0, F_ax, F_ax)))
     abs2, a_nac, iters = fn(waves, F_re, F_im)
     sigma = response_std(abs2, waves.w[0])
     return {

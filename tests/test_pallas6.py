@@ -138,3 +138,28 @@ def test_solver_flag_switches_both_drivers(monkeypatch):
 
     g = jax.grad(loss)(jnp.asarray(1.0))
     assert np.isfinite(float(g)) and float(g) != 0.0
+
+
+def test_enabled_knob_parsing(monkeypatch):
+    """Affirmative spellings force the kernel on, negative spellings force
+    it off, and a malformed value degrades to auto (with a warning) rather
+    than silently opting out of the measured TPU default."""
+    import warnings
+    from raft_tpu.core import pallas6
+
+    for v in ("1", "true", "ON", "Yes"):
+        monkeypatch.setenv("RAFT_TPU_PALLAS", v)
+        assert pallas6.enabled() is True
+    for v in ("0", "false", "OFF", "no"):
+        monkeypatch.setenv("RAFT_TPU_PALLAS", v)
+        assert pallas6.enabled() is False
+    auto = jax.default_backend() == "tpu"
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "maybe")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert pallas6.enabled() is auto
+    assert any("RAFT_TPU_PALLAS" in str(r.message) for r in rec)
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "")     # empty: auto, no warning
+    assert pallas6.enabled() is auto
+    monkeypatch.delenv("RAFT_TPU_PALLAS")
+    assert pallas6.enabled() is auto

@@ -313,6 +313,18 @@ def test_sea_state_sweep_sharded_matches_unsharded():
     mesh = Mesh(np.array(jax.devices()[:4]), axis_names=("cases",))
     out = sweep_sea_states(members, rna, env, waves, C_moor, mesh=mesh)
     np.testing.assert_allclose(out["std dev"], ref["std dev"], rtol=1e-12)
+
+    # shared-heading BEM: the excitation is staged ONCE ((nw,6), replicated
+    # over the mesh) while the per-case zeta scaling stays sharded
+    rng = np.random.default_rng(3)
+    nw = len(np.asarray(wave.w))
+    A = np.tile(np.eye(6)[:, :, None] * 4e6, (1, 1, nw))
+    B = np.tile(np.eye(6)[:, :, None] * 2e5, (1, 1, nw))
+    F = (rng.normal(size=(6, nw)) + 1j * rng.normal(size=(6, nw))) * 2e5
+    ref_b = sweep_sea_states(members, rna, env, waves, C_moor, bem=(A, B, F))
+    out_b = sweep_sea_states(members, rna, env, waves, C_moor, bem=(A, B, F),
+                             mesh=mesh)
+    np.testing.assert_allclose(out_b["std dev"], ref_b["std dev"], rtol=1e-12)
     with pytest.raises(ValueError, match="not divisible"):
         sweep_sea_states(members, rna, env,
                          make_wave_states(np.asarray(wave.w), cases[:3],

@@ -512,6 +512,18 @@ class ArrayModel:
             ax.set_axis_off()
         return ax
 
+    def plot_raos(self, axes=None):
+        """2x3 grid of per-DOF RAO magnitude curves, one line per turbine
+        (the layout is shared with :meth:`raft_tpu.model.Model.plot_raos`
+        via :func:`raft_tpu.model.plot_rao_grid`)."""
+        from raft_tpu.model import plot_rao_grid
+
+        if "response" not in self.results:
+            raise RuntimeError("run solveDynamics() before plot_raos()")
+        resp = self.results["response"]
+        return plot_rao_grid(np.asarray(resp["w"]),
+                             np.asarray(resp["RAO magnitude"]), axes=axes)
+
     def calcOutputs(self):
         if self.rao is None:
             raise RuntimeError("run solveDynamics first")

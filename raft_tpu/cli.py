@@ -351,11 +351,25 @@ def main(argv=None):
     else:
         model.print_report()
     if args.plot:
+        import sys
+
+        import matplotlib
+
+        # the CLI only ever savefig()s, so Agg is right — but a notebook
+        # calling main() programmatically already has pyplot (and its
+        # interactive backend) loaded, and an explicit MPLBACKEND is the
+        # user's choice either way: clobber neither
+        if ("matplotlib.pyplot" not in sys.modules
+                and "MPLBACKEND" not in os.environ):
+            matplotlib.use("Agg")
         import matplotlib.pyplot as plt
 
         model.plot()
         plt.savefig("raft_tpu_platform.png", dpi=120)
         print("wrote raft_tpu_platform.png")
+        model.plot_raos()
+        plt.savefig("raft_tpu_raos.png", dpi=120)
+        print("wrote raft_tpu_raos.png")
     return results
 
 

@@ -566,6 +566,18 @@ class Model:
             ax.set_axis_off()
         return ax
 
+    def plot_raos(self, axes=None):
+        """2x3 grid of RAO magnitude curves |Xi|/zeta per DOF vs frequency
+        — the response view the reference renders per fixed-point iterate
+        (raft/raft.py:1536-1539), here from the converged solve.  Run
+        ``solveDynamics()`` first; returns the axes array."""
+        if "response" not in self.results:
+            raise RuntimeError("run solveDynamics() before plot_raos()")
+        resp = self.results["response"]
+        return plot_rao_grid(np.asarray(resp["w"]),
+                             np.asarray(resp["RAO magnitude"])[None],
+                             axes=axes)
+
     def _plot_line(self, ax, ra, rf, st, i):
         import numpy as np
 
@@ -585,6 +597,41 @@ class Model:
             [x[:, None] * scale * u[None, :], z[:, None]], axis=1
         )
         ax.plot(*pts.T, "b-", lw=0.8)
+
+
+def plot_rao_grid(w, rao, axes=None, labels=None):
+    """2x3 grid of per-DOF RAO magnitude curves, one line per leading-axis
+    entry (turbines in an array; a single model passes ``rao[None]``).
+    The ONE layout shared by ``Model.plot_raos`` and
+    ``ArrayModel.plot_raos`` so the two views cannot drift apart.
+
+    ``w``: (nw,) [rad/s]; ``rao``: (nT, nw, 6) magnitudes.  Returns the
+    axes array."""
+    import matplotlib.pyplot as plt
+
+    f_hz = np.asarray(w) / (2.0 * np.pi)
+    rao = np.asarray(rao)
+    nT = rao.shape[0]
+    if axes is None:
+        _, axes = plt.subplots(2, 3, figsize=(12, 6), sharex=True)
+    dof = ("surge [m/m]", "sway [m/m]", "heave [m/m]",
+           "roll [rad/m]", "pitch [rad/m]", "yaw [rad/m]")
+    flat = np.asarray(axes).ravel()
+    if flat.size < 6:
+        raise ValueError(f"plot_rao_grid needs 6 axes (one per DOF), "
+                         f"got {flat.size}")
+    for i, ax in enumerate(flat[:6]):
+        for t in range(nT):
+            lbl = (labels[t] if labels is not None
+                   else f"T{t}" if nT > 1 else None)
+            ax.plot(f_hz, rao[t, :, i], label=lbl if i == 0 else None)
+        ax.set_ylabel(dof[i])
+        ax.grid(True, alpha=0.3)
+        if i >= 3:
+            ax.set_xlabel("frequency [Hz]")
+    if nT > 1 or labels is not None:
+        flat[0].legend(fontsize=7)
+    return axes
 
 
 def plot_member_wireframe(ax, m, offset=(0.0, 0.0), n_ring: int = 24):

@@ -116,6 +116,19 @@ def test_mixed_design_array_eigen():
         )
 
 
+def test_array_plot_raos_smoke(pair):
+    import matplotlib
+
+    matplotlib.use("Agg", force=True)
+    import matplotlib.pyplot as plt
+
+    axes = pair.plot_raos()
+    flat = np.asarray(axes).ravel()
+    assert flat.shape[0] == 6
+    assert all(len(a.lines) == pair.nT for a in flat)   # one curve/turbine
+    plt.close("all")
+
+
 def test_array_outputs_nacelle_accel(pair):
     out = pair.calcOutputs()
     a_nac = out["response"]["nacelle acceleration"]

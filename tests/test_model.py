@@ -83,6 +83,33 @@ def test_oc3_rao_solve(model):
     assert sigma[2] < 1.0
 
 
+def test_plot_smoke(model):
+    """Geometry wireframe and RAO-curve plots render without a display
+    (Agg) and return usable axes; plot_raos before a solve raises."""
+    import matplotlib
+
+    matplotlib.use("Agg", force=True)
+    import matplotlib.pyplot as plt
+
+    ax = model.plot()
+    assert len(ax.lines) > 0                     # member edges + moor lines
+    if "response" not in model.results:
+        model.calcMooringAndOffsets()
+        model.solveDynamics()
+    axes = model.plot_raos()
+    flat = np.asarray(axes).ravel()
+    assert flat.shape[0] == 6
+    assert all(len(a.lines) == 1 for a in flat)
+    # surge curve carries the solved RAO, not zeros
+    y = flat[0].lines[0].get_ydata()
+    assert np.isfinite(y).all() and y.max() > 0.1
+    plt.close("all")
+
+    m2 = Model(load_design(DESIGN))
+    with pytest.raises(RuntimeError, match="solveDynamics"):
+        m2.plot_raos()
+
+
 @pytest.mark.slow
 def test_fairlead_tension_outputs(model):
     model.calcMooringAndOffsets()

@@ -12,7 +12,7 @@ HERE = os.path.dirname(os.path.abspath(__file__))
 DESIGN = os.path.join(HERE, "..", "raft_tpu", "designs", "OC3spar.yaml")
 
 
-def main():
+def main(save_plots: bool = False):
     design = load_design(DESIGN)
     model = Model(design)
     model.setEnv(Hs=8.0, Tp=12.0, V=10.0,
@@ -30,6 +30,16 @@ def main():
           f"at w = {resp['w'][ipk]:.2f} rad/s")
     print(f"nacelle accel std dev {resp['nacelle acceleration std dev']:.3f} m/s^2")
 
+    if save_plots:
+        import matplotlib
+
+        matplotlib.use("Agg")
+        import matplotlib.pyplot as plt
+
+        model.plot_raos()
+        plt.savefig("oc3_raos.png", dpi=120)
+        print("wrote oc3_raos.png")
+
 
 if __name__ == "__main__":
-    main()
+    main(save_plots=True)

@@ -458,6 +458,37 @@ def serial_baseline_oc3(nw: int = 200):
     return _serial_rao(members, rna, wave, env, C_moor, nw=nw)
 
 
+def _spawn_full_bench(env, timeout_s: float):
+    """Run the FULL bench in a fresh child (``ASSUME_DEVICE=1``: no
+    re-probing) and parse its one stdout JSON line.  The ONE
+    spawn-and-parse convention shared by the parent's bounded device run
+    and the end-of-window wedge-clear retry, including the guard that a
+    child which silently fell back to CPU (plugin registration failure
+    after a good probe) is a FAILURE, not a device number.
+
+    Returns (parsed dict, None) for a genuine device measurement, else
+    (None, error dict)."""
+    env = dict(env)
+    env["RAFT_TPU_BENCH_ASSUME_DEVICE"] = "1"
+    try:
+        r = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)],
+            capture_output=True, text=True, timeout=timeout_s, env=env,
+        )
+        line = (r.stdout.strip().splitlines() or [""])[-1]
+        out = json.loads(line)
+        if out.get("value") and out.get("platform") not in (None, "cpu"):
+            return out, None
+        return None, {"class": "DeviceBenchFailed",
+                      "detail": out.get("error") or line[:500]}
+    except subprocess.TimeoutExpired:
+        return None, {"class": "DeviceBenchTimeout",
+                      "detail": f"device bench did not finish in "
+                                f"{timeout_s:.0f}s"}
+    except Exception as e:
+        return None, {"class": type(e).__name__, "detail": str(e)[-300:]}
+
+
 def _retry_device_bench(budget_s: float):
     """One last chance at a real device number after a CPU fallback: the
     wedge can clear mid-window, so re-probe the pinned backend and, if it
@@ -476,30 +507,16 @@ def _retry_device_bench(budget_s: float):
     platform, probe_err = _probe_backend(retries=1, env=env)
     if platform in (None, "cpu"):           # cpu = the pin, not the device
         return None, {"class": "RetryProbeFailed", **(probe_err or {})}
-    env["RAFT_TPU_BENCH_ASSUME_DEVICE"] = "1"
     # the probe spent part of the remaining budget; the subprocess gets
     # what is left so the whole bench stays inside the driver wall-clock
     sub_timeout = budget_s - (time.perf_counter() - t0)
     if sub_timeout < 60:
         return None, {"class": "RetrySkipped",
                       "detail": f"probe left only {sub_timeout:.0f}s"}
-    try:
-        r = subprocess.run(
-            [sys.executable, os.path.abspath(__file__)],
-            capture_output=True, text=True, timeout=sub_timeout, env=env,
-        )
-        line = (r.stdout.strip().splitlines() or [""])[-1]
-        out = json.loads(line)
-        if out.get("value") and out.get("platform") not in (None, "cpu"):
-            return out, None
-        return None, {"class": "RetryBenchFailed",
-                      "detail": out.get("error") or line[:500]}
-    except subprocess.TimeoutExpired:
-        return None, {"class": "RetryBenchTimeout",
-                      "detail": f"device bench did not finish in "
-                                f"{sub_timeout:.0f}s"}
-    except Exception as e:
-        return None, {"class": type(e).__name__, "detail": str(e)[-300:]}
+    out, err = _spawn_full_bench(env, sub_timeout)
+    if out is not None:
+        return out, None
+    return None, {"class": "RetryBenchFailed", "device_error": err}
 
 
 def main():
@@ -518,14 +535,37 @@ def main():
     t_start = time.perf_counter()
     budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET", "1200"))
     metric = "design-freq RAO solves/sec/chip (1k VolturnUS-S x 200w, BEM staged)"
-    if os.environ.get("RAFT_TPU_BENCH_ASSUME_DEVICE"):
-        # retry subprocess: the parent probed the backend a moment ago —
-        # run the full device bench directly, no further probing
+    assume_device = bool(os.environ.get("RAFT_TPU_BENCH_ASSUME_DEVICE"))
+    device_died = None
+    if assume_device:
+        # child subprocess: the parent probed (or re-probed) the backend a
+        # moment ago — run the full device bench directly, no probing
         platform, probe_err = "device", None
         fallback = False
     else:
         platform, probe_err = _probe_backend()
         fallback = platform is None
+    if not fallback and not assume_device:
+        # The device answered the probe, but it can still hang or die
+        # MID-BENCH (e.g. the tunnel drops): its client retries
+        # UNAVAILABLE internally for tens of minutes, unbounded and
+        # un-interruptible in-process.  So the device bench runs in a
+        # CHILD under a parent wall-clock, and this parent keeps its own
+        # jax uninitialized (the probe is also a subprocess) — on child
+        # timeout/failure it falls back to the labeled in-process CPU
+        # path below, so the artifact is a measurement, not a null.
+        reserve = 240.0                      # time kept for the CPU rescue
+        sub_timeout = budget_s - (time.perf_counter() - t_start) - reserve
+        out, device_died = _spawn_full_bench(os.environ,
+                                             max(60.0, sub_timeout))
+        if out is not None:
+            print(json.dumps(out))
+            return
+        # fall through to the CPU fallback, carrying the device error
+        fallback = True
+        platform = None
+        probe_err = {"class": "DeviceDiedMidBench",
+                     "device_error": device_died}
     if fallback:
         # the pinned backend is unreachable: measure on CPU with reduced
         # batches so the artifact stays inside the driver's time budget.
@@ -593,8 +633,11 @@ def main():
             )
             out["backend_probe_error"] = probe_err
             # the wedge may have cleared while the CPU workloads ran:
-            # re-probe, and promote a successful full device bench
-            remaining = budget_s - (time.perf_counter() - t_start) - 30
+            # re-probe, and promote a successful full device bench (but
+            # not after a mid-bench death — that device is flapping, not
+            # wedged-at-start, and re-dialing it would just flap again)
+            remaining = (-1.0 if device_died is not None else
+                         budget_s - (time.perf_counter() - t_start) - 30)
             dev_out, retry_err = _retry_device_bench(remaining)
             if dev_out is not None:
                 dev_out["note"] = (
@@ -610,6 +653,8 @@ def main():
                 out["tpu_retry"] = retry_err
         print(json.dumps(out))
     except Exception as e:  # emit a diagnostic line, not a stack trace
+        # (a child with ASSUME_DEVICE lands here on a mid-bench device
+        # death; its parent parses this line and runs the CPU fallback)
         print(
             json.dumps(
                 {

@@ -31,8 +31,11 @@ def main(save_plots: bool = False):
     print(f"nacelle accel std dev {resp['nacelle acceleration std dev']:.3f} m/s^2")
 
     if save_plots:
-        import matplotlib
-
+        try:
+            import matplotlib
+        except ImportError:
+            print("matplotlib not installed: skipping the RAO figure")
+            return
         matplotlib.use("Agg")
         import matplotlib.pyplot as plt
 

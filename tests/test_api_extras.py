@@ -136,6 +136,7 @@ def test_cli_optimize_json(capsys):
     assert res["history"][-1] <= res["history"][0] + 1e-12
 
 
+@pytest.mark.slow
 def test_print_report(capsys):
     m = Model(load_design("raft_tpu/designs/OC3spar.yaml"),
               w=np.arange(0.2, 1.2, 0.2))

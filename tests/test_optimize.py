@@ -224,6 +224,7 @@ def test_short_crested_codesign_with_bem_heading_grid(oc3):
                    scale_diameters, (A, Bh, F_all[0]), 25, False)
 
 
+@pytest.mark.slow
 def test_robust_dlc_with_raw_bem_matches_per_case(oc3):
     """Batched waves + BEM: the per-case zeta re-staging inside the robust
     loss equals staging each case by hand; stage_bem output is rejected

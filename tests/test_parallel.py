@@ -93,6 +93,7 @@ def test_sea_state_sweep_with_bem_matches_staged_single():
         np.testing.assert_allclose(out["std dev"][i], sig, rtol=1e-12)
 
 
+@pytest.mark.slow
 def test_sweep_sea_states_heading_axis():
     """(Hs, Tp, beta) DLC rows: each case lane carries its own wave heading
     through the node kinematics, pinned against per-case single solves."""

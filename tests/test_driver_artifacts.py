@@ -70,3 +70,52 @@ def test_evidence_flags_missing_bench_json():
     parsed = [ln for ln in art["stdout_tail"]
               if ln.strip().startswith("{")]
     assert parsed == []
+
+
+def test_spawn_full_bench_guards(tmp_path, monkeypatch):
+    """The bench parent's child-spawn helper promotes only a genuine device
+    number: a child that silently fell back to CPU (plugin registration
+    failure after a good probe) or emitted its value-null diagnostic is a
+    FAILURE, and a hung child is killed at the parent's wall-clock.  The
+    child interpreter is faked so each case is deterministic."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    def fake_child(script: str) -> str:
+        p = tmp_path / f"fake_{abs(hash(script)) % 10**8}.sh"
+        p.write_text(f"#!/bin/sh\n{script}\n")
+        p.chmod(0o755)
+        return str(p)
+
+    # 1. genuine device number -> promoted
+    good = json.dumps({"value": 1.0e6, "platform": "tpu"})
+    monkeypatch.setattr(bench.sys, "executable",
+                        fake_child(f"echo '{good}'"))
+    out, err = bench._spawn_full_bench({}, 30.0)
+    assert err is None and out["platform"] == "tpu"
+
+    # 2. full-batch number but on CPU (silent fallback) -> rejected
+    cpu = json.dumps({"value": 2.0e4, "platform": "cpu"})
+    monkeypatch.setattr(bench.sys, "executable",
+                        fake_child(f"echo '{cpu}'"))
+    out, err = bench._spawn_full_bench({}, 30.0)
+    assert out is None and err["class"] == "DeviceBenchFailed"
+
+    # 3. the child's own value-null diagnostic -> rejected, error surfaced
+    diag = json.dumps({"value": None, "platform": "tpu",
+                       "error": {"class": "JaxRuntimeError",
+                                 "detail": "UNAVAILABLE: tunnel dropped"}})
+    monkeypatch.setattr(bench.sys, "executable",
+                        fake_child(f"echo '{diag}'"))
+    out, err = bench._spawn_full_bench({}, 30.0)
+    assert out is None
+    assert "UNAVAILABLE" in json.dumps(err)
+
+    # 4. hung child -> killed at the parent's wall-clock, classified
+    monkeypatch.setattr(bench.sys, "executable", fake_child("sleep 60"))
+    out, err = bench._spawn_full_bench({}, 2.0)
+    assert out is None and err["class"] == "DeviceBenchTimeout"

@@ -530,7 +530,12 @@ def main():
     round a TPU number, not the whole artifact.  Because a wedge can also
     CLEAR mid-window, a fallback run re-probes the device after the CPU
     workloads finish and promotes a successful full device bench (in a
-    fresh subprocess) to the primary result.
+    fresh subprocess) to the primary result.  And because a device that
+    answered the probe can still die MID-BENCH (its client retries
+    UNAVAILABLE internally, unbounded and un-interruptible in-process),
+    the device bench itself runs in a child under a parent wall-clock —
+    on child timeout/failure the parent, whose jax is still
+    uninitialized, measures the labeled CPU fallback in-process.
     """
     t_start = time.perf_counter()
     budget_s = float(os.environ.get("RAFT_TPU_BENCH_BUDGET", "1200"))

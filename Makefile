@@ -2,10 +2,13 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun
+.PHONY: fast test evidence bench dryrun cache-smoke
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
+
+cache-smoke:     ## warm-start proof: tiny sweep twice in fresh processes,
+	python -m raft_tpu.cache smoke   # 2nd run's compile must be < 50% of 1st
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

@@ -121,7 +121,8 @@ def _volturn_setup(nw: int = 200, nw_bem: int = 48):
     from raft_tpu.parallel import stage_bem
 
     here = os.path.dirname(os.path.abspath(__file__))
-    design = load_design(os.path.join(here, "raft_tpu", "designs", "VolturnUS-S.yaml"))
+    design_path = os.path.join(here, "raft_tpu", "designs", "VolturnUS-S.yaml")
+    design = load_design(design_path)
     members = build_member_set(design)
     rna = build_rna(design)
     depth = float(design["mooring"]["water_depth"])
@@ -139,16 +140,33 @@ def _volturn_setup(nw: int = 200, nw_bem: int = 48):
 
     # host-side BEM precompute: coarse grid -> interpolate to the model grid
     # (tests/test_bem_staging.py pins this interpolation's response error
-    # against a 2x denser coarse grid)
+    # against a 2x denser coarse grid).  The whole block — meshing, panel
+    # solve, interpolation — is a pure function of the design file + grids,
+    # so the warm-start staging cache memoizes its (A, B, F) output on
+    # disk: a repeat process skips the 3 s setup_bem_stage phase entirely.
+    from raft_tpu import cache
     from raft_tpu.hydro.bem_io import interp_to_grid
 
-    panels = mesh_design(design, dz_max=3.0, da_max=2.0)
-    w_bem = np.linspace(w[0], w[-1], nw_bem)
-    A_c, B_c, F_c = solve_bem(panels, w_bem, rho=float(env.rho), g=float(env.g),
-                              beta=0.0, depth=depth)
-    A = interp_to_grid(w_bem, np.asarray(A_c), w)
-    B = interp_to_grid(w_bem, np.asarray(B_c), w)
-    F = interp_to_grid(w_bem, np.asarray(F_c), w)
+    def _stage_abf():
+        panels = mesh_design(design, dz_max=3.0, da_max=2.0)
+        w_bem = np.linspace(w[0], w[-1], nw_bem)
+        A_c, B_c, F_c = solve_bem(panels, w_bem, rho=float(env.rho),
+                                  g=float(env.g), beta=0.0, depth=depth)
+        return (
+            interp_to_grid(w_bem, np.asarray(A_c), w),
+            interp_to_grid(w_bem, np.asarray(B_c), w),
+            interp_to_grid(w_bem, np.asarray(F_c), w),
+        )
+
+    if cache.is_enabled():
+        A, B, F = cache.cached_arrays(
+            "volturn_bem_stage",
+            (cache.FileKey(design_path), w, int(nw_bem), float(env.rho),
+             float(env.g), float(depth), 3.0, 2.0),
+            _stage_abf,
+        )
+    else:
+        A, B, F = _stage_abf()
     bem = stage_bem((A, B, F), wave)
     return design, members, rna, env, wave, C_moor, bem
 
@@ -208,9 +226,18 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
     from raft_tpu.utils import profiling as prof
 
     # AOT-compile once (all chunks share one shape) so the timed loop is
-    # pure execution AND the executable exposes XLA's own FLOP estimate
+    # pure execution AND the executable exposes XLA's own FLOP estimate.
+    # The compile goes through the warm-start registry: a repeat process
+    # deserializes the stored executable (or at worst re-traces into the
+    # persistent XLA cache) instead of paying the full compile.
+    from raft_tpu import cache
+
     with prof.phase("north_star/compile"):
-        compiled = jax.jit(jax.vmap(one)).lower(scales[0]).compile()
+        compiled = cache.cached_compile(
+            "bench.north_star", jax.vmap(one), (scales[0],),
+            consts=(members, rna, env, wave, C_moor, bem),
+            extra=("n_iter", 40, "method", "while"),
+        )
     flops_chunk = _flops_per_call(compiled)
 
     def run_all():
@@ -330,8 +357,16 @@ def oc3_strip_throughput(batch: int = 2048, nw: int = 200, reps: int = 3):
         )
         return out.Xi.abs2(), out.converged
 
-    fwd = jax.jit(jax.vmap(one))
+    from raft_tpu import cache
+    from raft_tpu.utils import profiling as prof
+
     scales = jnp.linspace(0.9, 1.1, batch)
+    with prof.phase("oc3_strip/compile"):
+        fwd = cache.cached_callable(
+            "bench.oc3_strip", jax.vmap(one), (scales,),
+            consts=(members, rna, env, wave, C_moor),
+            extra=("n_iter", 40, "method", "while"),
+        )
     out, conv = fwd(scales)
     out.block_until_ready()                       # compile + warm cache
     assert bool(np.asarray(conv).all()), "unconverged OC3 lanes"
@@ -458,6 +493,31 @@ def serial_baseline_oc3(nw: int = 200):
     return _serial_rao(members, rna, wave, env, C_moor, nw=nw)
 
 
+def _stderr_tail(stderr, n: int = 300) -> str:
+    """Last ~n chars of a child's stderr for an error dict, with
+    credential-looking tokens masked (these diagnostics land verbatim in
+    committed bench artifacts)."""
+    if not stderr:
+        return ""
+    if isinstance(stderr, bytes):
+        stderr = stderr.decode("utf-8", "replace")
+    import re
+
+    # redact BEFORE truncating: slicing first could cut the key prefix
+    # ('Bearer ', 'api_key=') off a credential that straddles the cut,
+    # leaving the bare token with nothing for the patterns to anchor on.
+    # Header form first ("Authorization: Bearer <tok>" / bare
+    # "Bearer <tok>" — the credential follows the word, no = or : between
+    # them), then key=value / key: value forms, then bare sk-style keys.
+    text = re.sub(r"(?i)(bearer\s+)\S+", r"\1[redacted]", stderr.strip())
+    text = re.sub(
+        r"(?i)((?:api[_-]?key|token|secret|password|authorization)"
+        r"\S*\s*[=:]\s*)\S+",
+        r"\1[redacted]", text,
+    )
+    return re.sub(r"\bsk-[A-Za-z0-9_-]{8,}", "[redacted]", text)[-n:]
+
+
 def _spawn_full_bench(env, timeout_s: float):
     """Run the FULL bench in a fresh child (``ASSUME_DEVICE=1``: no
     re-probing) and parse its one stdout JSON line.  The ONE
@@ -465,6 +525,10 @@ def _spawn_full_bench(env, timeout_s: float):
     and the end-of-window wedge-clear retry, including the guard that a
     child which silently fell back to CPU (plugin registration failure
     after a good probe) is a FAILURE, not a device number.
+
+    A child that dies without a parseable JSON line (OOM kill,
+    interpreter crash) surfaces a redacted tail of its stderr in the
+    error dict — the actual diagnostic, not just a JSONDecodeError.
 
     Returns (parsed dict, None) for a genuine device measurement, else
     (None, error dict)."""
@@ -475,18 +539,40 @@ def _spawn_full_bench(env, timeout_s: float):
             [sys.executable, os.path.abspath(__file__)],
             capture_output=True, text=True, timeout=timeout_s, env=env,
         )
-        line = (r.stdout.strip().splitlines() or [""])[-1]
-        out = json.loads(line)
-        if out.get("value") and out.get("platform") not in (None, "cpu"):
-            return out, None
-        return None, {"class": "DeviceBenchFailed",
-                      "detail": out.get("error") or line[:500]}
-    except subprocess.TimeoutExpired:
-        return None, {"class": "DeviceBenchTimeout",
-                      "detail": f"device bench did not finish in "
-                                f"{timeout_s:.0f}s"}
+    except subprocess.TimeoutExpired as e:
+        err = {"class": "DeviceBenchTimeout",
+               "detail": f"device bench did not finish in "
+                         f"{timeout_s:.0f}s"}
+        tail = _stderr_tail(getattr(e, "stderr", None))
+        if tail:
+            err["stderr_tail"] = tail
+        return None, err
     except Exception as e:
         return None, {"class": type(e).__name__, "detail": str(e)[-300:]}
+    line = (r.stdout.strip().splitlines() or [""])[-1]
+    try:
+        out = json.loads(line)
+    except json.JSONDecodeError:
+        out = None
+    if not isinstance(out, dict):
+        # no JSON at all, or a stray non-dict line ('null', a number, a
+        # progress list): either way there is no child result — surface
+        # the diagnostics instead of raising out of the rescue path
+        err = {"class": "DeviceBenchFailed",
+               "detail": f"child stdout had no JSON result line "
+                         f"(rc={r.returncode}): {line[:200]!r}"}
+        tail = _stderr_tail(r.stderr)
+        if tail:
+            err["stderr_tail"] = tail
+        return None, err
+    if out.get("value") and out.get("platform") not in (None, "cpu"):
+        return out, None
+    err = {"class": "DeviceBenchFailed",
+           "detail": out.get("error") or line[:500]}
+    tail = _stderr_tail(r.stderr)
+    if tail:
+        err["stderr_tail"] = tail
+    return None, err
 
 
 def _retry_device_bench(budget_s: float):
@@ -561,8 +647,19 @@ def main():
         # path below, so the artifact is a measurement, not a null.
         reserve = 240.0                      # time kept for the CPU rescue
         sub_timeout = budget_s - (time.perf_counter() - t_start) - reserve
-        out, device_died = _spawn_full_bench(os.environ,
-                                             max(60.0, sub_timeout))
+        if sub_timeout < 60.0:
+            # a 60 s floor here could overshoot a small driver budget:
+            # when less than the floor remains after the CPU-rescue
+            # reserve, skip the device child entirely and go straight to
+            # the in-process CPU fallback
+            out, device_died = None, {
+                "class": "DeviceBenchSkipped",
+                "detail": f"budget leaves {sub_timeout:.0f}s for the "
+                          f"device child after the {reserve:.0f}s "
+                          f"CPU-rescue reserve (< 60s floor)",
+            }
+        else:
+            out, device_died = _spawn_full_bench(os.environ, sub_timeout)
         if out is not None:
             print(json.dumps(out))
             return
@@ -584,6 +681,15 @@ def main():
 
         jax.config.update("jax_platforms", "cpu")
         platform = "cpu"
+    # warm-start subsystem: persistent XLA compile cache + AOT executable
+    # registry + BEM staging cache.  Armed AFTER the platform decision (the
+    # registry keys by backend) and before any workload; RAFT_TPU_CACHE_DIR
+    # governs (``off`` disables, keeping the run bit-identical to an
+    # uncached build).  Cache wall-clock shows up as cache/* phases and
+    # hit/miss counts in the warm_start block below.
+    from raft_tpu import cache as _warm
+
+    _warm.enable()
     ns_kw = {} if not fallback else {"batch": 100, "chunk": 50, "reps": 1}
     oc3_kw = {} if not fallback else {"batch": 128, "reps": 1}
     try:
@@ -630,6 +736,10 @@ def main():
                 "oc3_strip": round(base_o, 1),
             },
             "phases_s": {k: round(v, 3) for k, v in prof.totals().items()},
+            # cold/warm split: cache hit/miss counts + saved seconds per
+            # layer — a warm process shows aot disk_hits / staging hits
+            # with north_star/compile + setup_bem_stage collapsed
+            "warm_start": _warm.report(),
         }
         if fallback:
             out["note"] = (

@@ -1,0 +1,38 @@
+"""Warm-start subsystem: persistent compile cache, AOT executable reuse,
+and on-disk staging of host-precomputed BEM coefficients.
+
+A cold north-star process is >94% warm-up (XLA compilation 11.45 s +
+host-side BEM staging 3.08 s against a 0.82 s compiled sweep, BENCH_r05);
+this package makes the second process start hot:
+
+* :func:`enable` — the one switch.  Wires JAX's persistent compilation
+  cache under the cache root and arms the two layers below.  Honors
+  ``RAFT_TPU_CACHE_DIR`` (``off`` disables everything, keeping runs
+  bit-identical to an uncached build).  Called by the CLI and the bench
+  at startup; library users opt in explicitly.
+* :mod:`raft_tpu.cache.aot` — compiled-executable registry keyed by
+  (function tag, abstract arg shapes/dtypes, closure-consts content hash,
+  device topology, version salts), serialized across processes.
+* :mod:`raft_tpu.cache.staging` — content-addressed npz cache for
+  host-side staging (WAMIT parses, BEM grid solves + interpolation,
+  heading-row interpolation).
+* :mod:`raft_tpu.cache.stats` — hit/miss/saved-seconds ledger; its
+  :func:`~raft_tpu.cache.stats.report` is the bench JSON's ``warm_start``
+  block.
+"""
+from raft_tpu.cache.config import (  # noqa: F401
+    cache_dir,
+    default_dir,
+    disable,
+    enable,
+    is_enabled,
+    resolve_dir,
+)
+from raft_tpu.cache.aot import (  # noqa: F401
+    aot_key,
+    cached_callable,
+    cached_compile,
+    callable_salt,
+)
+from raft_tpu.cache.staging import FileKey, cached_arrays, staging_key  # noqa: F401
+from raft_tpu.cache.stats import report  # noqa: F401

@@ -1,0 +1,138 @@
+"""Cache smoke check: prove the warm-start subsystem works on this machine.
+
+``python -m raft_tpu.cache smoke`` runs a tiny OC3 design sweep TWICE in
+separate processes sharing one fresh cache dir and asserts the second
+process's compile wall-clock (AOT load + any residual compile) is below a
+threshold fraction of the first's — the cross-process warm-start claim,
+verified end-to-end in ~a minute on CPU.  Exit code 0/1; prints one JSON
+line with both processes' numbers.  ``make cache-smoke`` wraps it; a
+smaller variant runs inside the test suite (tests/test_cache.py).
+
+``python -m raft_tpu.cache child`` is the per-process payload (internal).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+
+def _child(argv) -> None:
+    p = argparse.ArgumentParser(prog="raft_tpu.cache child")
+    p.add_argument("--n", type=int, default=8)
+    p.add_argument("--nw", type=int, default=30)
+    args = p.parse_args(argv)
+
+    # the smoke must never dial a hardware backend: pin CPU before jax init
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from raft_tpu import cache
+    from raft_tpu.utils import profiling as prof
+
+    cache.enable()                      # RAFT_TPU_CACHE_DIR from the parent
+
+    import jax.numpy as jnp
+
+    import __graft_entry__ as ge
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+    from raft_tpu.parallel import sweep
+
+    design, members, rna, env, wave = ge._base(nw=args.nw)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    thetas = jnp.linspace(0.95, 1.05, args.n)
+    out = sweep(members, rna, env, wave, C_moor, thetas, n_iter=25)
+    print(json.dumps({
+        "phases_s": {k: round(v, 4) for k, v in prof.totals().items()},
+        "warm_start": cache.report(),
+        "std0": float(out["std dev"][0, 0]),   # cold/warm must agree
+    }))
+
+
+def _run_child(cache_dir: str, n: int, nw: int) -> dict:
+    env = dict(os.environ)
+    env["RAFT_TPU_CACHE_DIR"] = cache_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    # the smoke must be deterministic whatever environment launches it: a
+    # caller's virtual-device mesh (e.g. the test suite's 8-CPU XLA_FLAGS)
+    # changes XLA-CPU compile times enough to swamp the tiny workload
+    env.pop("XLA_FLAGS", None)
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.cache", "child",
+         "--n", str(n), "--nw", str(nw)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+    )
+    if r.returncode != 0:
+        raise SystemExit(
+            f"cache-smoke child failed (rc={r.returncode}):\n"
+            + (r.stderr or r.stdout)[-2000:]
+        )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def compile_seconds(phases: dict) -> float:
+    """Wall-clock a process spent producing executables: trace+compile plus
+    the warm path's artifact loads."""
+    return sum(v for k, v in phases.items()
+               if k.endswith(("cache/aot_compile", "cache/aot_load")))
+
+
+def smoke(argv) -> int:
+    p = argparse.ArgumentParser(prog="raft_tpu.cache smoke")
+    p.add_argument("--n", type=int, default=8, help="design variants")
+    p.add_argument("--nw", type=int, default=30, help="frequency bins")
+    p.add_argument("--threshold", type=float, default=0.5,
+                   help="warm compile must be below this fraction of cold")
+    p.add_argument("--dir", default=None,
+                   help="cache dir (default: fresh temp dir, removed after)")
+    args = p.parse_args(argv)
+
+    d = args.dir or tempfile.mkdtemp(prefix="raft_tpu_cache_smoke_")
+    try:
+        cold = _run_child(d, args.n, args.nw)
+        warm = _run_child(d, args.n, args.nw)
+        cold_s = compile_seconds(cold["phases_s"])
+        warm_s = compile_seconds(warm["phases_s"])
+        hits = warm["warm_start"].get("aot", {}).get("disk_hits", 0)
+        ok = (hits >= 1 and warm_s < args.threshold * cold_s
+              and warm["std0"] == cold["std0"])
+        print(json.dumps({
+            "ok": ok,
+            "cold_compile_s": round(cold_s, 3),
+            "warm_compile_s": round(warm_s, 3),
+            "speedup": round(cold_s / warm_s, 1) if warm_s > 0 else None,
+            "warm_aot_disk_hits": hits,
+            "results_identical": warm["std0"] == cold["std0"],
+            "cache_dir": d,
+        }))
+        return 0 if ok else 1
+    finally:
+        if args.dir is None:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if argv and argv[0] == "child":
+        _child(argv[1:])
+        return 0
+    if argv and argv[0] == "smoke":
+        return smoke(argv[1:])
+    print("usage: python -m raft_tpu.cache smoke [--n N] [--nw NW] "
+          "[--threshold R] [--dir D]", file=sys.stderr)
+    return 2
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -1,0 +1,303 @@
+"""AOT executable registry: compile once per (program, signature, topology),
+reuse across calls AND across processes.
+
+The hot entry points (``parallel/sweep.py``'s batched/sharded forwards,
+``parallel/optimize.py``'s value-and-grad step, ``bench.py``'s north-star
+chunk solve) are each ONE large XLA program recompiled identically by every
+process.  This registry keys the compiled executable by
+
+* a **function tag** (stable call-site name, e.g. ``"sweep_sea_states"``),
+* the **abstract signature** of the call arguments (pytree structure +
+  shape/dtype of every leaf),
+* a **consts fingerprint** — a content hash of every array the traced
+  function closes over (member geometry, staged BEM coefficients, mooring
+  stiffness, ...).  Closure constants are baked into the HLO, so two
+  designs with identical shapes still need distinct executables; the call
+  site passes everything its closure captures and the registry hashes it,
+* the **device topology** (backend platform, device kind, device count,
+  mesh axis names/shape when sharded) — an executable is loadable only on
+  the topology it was built for,
+* **version salts** (jax / jaxlib / raft_tpu versions) so an upgrade
+  invalidates rather than deserializes garbage.
+
+Storage layers, tried in order:
+
+1. in-process memo (dict) — repeat calls in one process never re-lower;
+2. on-disk serialized executable (``jax.experimental.serialize_executable``,
+   the PJRT executable bytes) — a warm process skips BOTH tracing and XLA
+   compilation.  Any deserialize failure (corrupt file, incompatible
+   runtime) silently falls through to layer 3;
+3. trace + compile — which itself hits JAX's persistent compilation cache
+   (wired by :func:`raft_tpu.cache.config.enable`), so even when the
+   executable artifact is unusable the warm process pays tracing only.
+
+With the cache disabled the registry vanishes: :func:`cached_callable`
+returns a plain ``jax.jit`` (today's exact dispatch path, bit-identical),
+and :func:`cached_compile` performs a plain ``lower().compile()``.
+"""
+from __future__ import annotations
+
+import hashlib
+import inspect
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from raft_tpu.cache import config, stats
+from raft_tpu.cache.staging import _update
+
+_mem: dict = {}
+
+
+def _version_salts() -> tuple:
+    import jax
+
+    try:
+        import jaxlib
+
+        jl = getattr(jaxlib, "__version__", "?")
+    except Exception:  # pragma: no cover
+        jl = "?"
+    import raft_tpu
+
+    return ("jax=" + jax.__version__, "jaxlib=" + jl,
+            "raft_tpu=" + raft_tpu.__version__,
+            # any in-repo source edit invalidates: the traced program
+            # depends on library code that shapes/consts cannot see
+            "code=" + config.code_fingerprint())
+
+
+def _topology(mesh=None) -> tuple:
+    import jax
+
+    devs = jax.devices()
+    topo = (jax.default_backend(), devs[0].device_kind, len(devs))
+    if mesh is not None:
+        topo += (tuple(mesh.axis_names), tuple(int(s) for s in mesh.devices.shape))
+    return topo
+
+
+def callable_salt(fn, _depth: int = 0) -> tuple:
+    """Best-effort identity of a user-supplied callable for the key:
+    qualified name + source hash + a fingerprint of its closure cells.
+    The closure matters: ``make_apply(0.5)`` and ``make_apply(2.0)`` share
+    name and source, and only the captured value distinguishes the traced
+    programs — missing it would let a warm process reuse an executable
+    with the WRONG constant baked in.  Cells holding arrays/scalars hash
+    by content; nested callables recurse (bounded); anything opaque hashes
+    by ``repr``, which over-invalidates the disk layer (a new process
+    recompiles) rather than aliasing.  Source-less definitions (REPL /
+    ``exec``) are covered by the bytecode + literal-constants hash.  The
+    salt is best-effort, not a proof: a hook whose behavior hides behind
+    an opaque object with a stable ``repr`` defeats it — pass such state
+    via ``consts``.  In-repo call
+    sites additionally cover their array state via ``consts``; this salt
+    guards the user-pluggable hooks (``apply_fn`` / ``objective``)."""
+    name = f"{getattr(fn, '__module__', '?')}.{getattr(fn, '__qualname__', repr(fn))}"
+    h = hashlib.sha256()
+    try:
+        h.update(inspect.getsource(fn).encode())
+    except (OSError, TypeError):
+        h.update(name.encode())
+    code = getattr(fn, "__code__", None)
+    if code is not None:
+        # bytecode + literal constants: distinguishes two same-named hooks
+        # even when no source is retrievable (REPL / exec-defined lambdas,
+        # where getsource raises for both)
+        h.update(code.co_code)
+        h.update(repr(code.co_consts).encode())
+    cells = getattr(fn, "__closure__", None) or ()
+    for cell in cells:
+        try:
+            v = cell.cell_contents
+        except ValueError:             # empty cell
+            h.update(b"<empty>")
+            continue
+        if callable(v) and _depth < 3:
+            _update(h, callable_salt(v, _depth + 1))
+        elif hasattr(v, "shape") or isinstance(
+                v, (int, float, bool, str, bytes, np.generic, type(None))):
+            _update(h, np.asarray(v) if hasattr(v, "shape") else v)
+        elif isinstance(v, (list, tuple)) and all(
+                callable(x) or isinstance(x, (int, float, bool, str))
+                or hasattr(x, "shape") for x in v):
+            for x in v:
+                _update(h, callable_salt(x, _depth + 1) if callable(x)
+                        else (np.asarray(x) if hasattr(x, "shape") else x))
+        else:
+            h.update(repr(v).encode())
+    return (name, h.hexdigest()[:16])
+
+
+def _abstract_signature(args) -> tuple:
+    """Pytree structure + per-leaf (shape, dtype) of the call arguments."""
+    import jax
+
+    leaves, treedef = jax.tree_util.tree_flatten(args)
+    sig = [str(treedef)]
+    for leaf in leaves:
+        a = np.asarray(leaf) if not hasattr(leaf, "shape") else leaf
+        sig.append(f"{getattr(a, 'dtype', type(a).__name__)}:{getattr(a, 'shape', ())}")
+    return tuple(sig)
+
+
+def _consts_fingerprint(consts) -> str:
+    """Content hash of the closure-captured pytree (arrays by bytes)."""
+    import jax
+
+    h = hashlib.sha256()
+    leaves, treedef = jax.tree_util.tree_flatten(consts)
+    h.update(str(treedef).encode())
+    for leaf in leaves:
+        _update(h, np.asarray(leaf) if hasattr(leaf, "shape") or isinstance(
+            leaf, (int, float, bool, np.generic)) else leaf)
+    return h.hexdigest()[:32]
+
+
+def _solver_salts() -> tuple:
+    """Runtime knobs that change the traced/compiled program without
+    appearing in any argument: the Pallas kernel routing, x64 mode,
+    matmul precision, and raw XLA flags.  Keyed centrally so no call site
+    can forget them — JAX's persistent compile cache keys on its compile
+    options, and the AOT layer must not bypass that protection."""
+    import jax
+
+    from raft_tpu.core import pallas6
+
+    return ("pallas", bool(pallas6.enabled()),
+            "x64", bool(jax.config.jax_enable_x64),
+            "matmul", str(getattr(jax.config, "jax_default_matmul_precision",
+                                  None)),
+            "xla_flags", os.environ.get("XLA_FLAGS", ""))
+
+
+def aot_key(tag: str, args, consts=(), mesh=None, extra=()) -> str:
+    """Hex digest naming one executable in the registry."""
+    h = hashlib.sha256()
+    for part in (("tag", tag), _version_salts(), _topology(mesh),
+                 _solver_salts(), _abstract_signature(args),
+                 ("consts", _consts_fingerprint(consts)), tuple(extra)):
+        _update(h, part)
+    return h.hexdigest()[:32]
+
+
+def _disk_path(key: str) -> str:
+    return os.path.join(config.subdir("aot"), f"{key}.pjrt")
+
+
+def _try_load(key: str):
+    """Deserialize a stored executable; None on any failure (the corrupt
+    artifact is removed so it cannot fail every future run)."""
+    path = _disk_path(key)
+    if not os.path.exists(path):
+        return None
+    from raft_tpu.utils import profiling as prof
+
+    t0 = time.perf_counter()
+    try:
+        from jax.experimental import serialize_executable as se
+
+        with prof.phase("cache/aot_load", sync=False):
+            with open(path, "rb") as f:
+                import pickle
+
+                payload, in_tree, out_tree, cold_s = pickle.load(f)
+            loaded = se.deserialize_and_load(payload, in_tree, out_tree)
+        load_s = time.perf_counter() - t0
+        stats.record("aot", "disk_hit", saved_s=max(0.0, cold_s - load_s))
+        return loaded
+    except Exception:
+        stats.record("aot", "error")
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        return None
+
+
+def _try_store(key: str, compiled, cold_s: float) -> None:
+    """Best-effort serialize; never fails the run (e.g. executables with
+    host callbacks are unserializable — the persistent XLA cache still
+    covers their recompile)."""
+    from raft_tpu.utils import profiling as prof
+
+    try:
+        from jax.experimental import serialize_executable as se
+
+        with prof.phase("cache/aot_save", sync=False):
+            payload, in_tree, out_tree = se.serialize(compiled)
+            import pickle
+
+            d = os.path.dirname(_disk_path(key))
+            fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    pickle.dump((payload, in_tree, out_tree, cold_s), f)
+                os.replace(tmp, _disk_path(key))
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+    except Exception:
+        stats.record("aot", "error")
+
+
+def cached_compile(tag: str, fn, args, *, consts=(), mesh=None,
+                   jit_kwargs: dict | None = None, extra=()):
+    """``jax.jit(fn, **jit_kwargs).lower(*args).compile()`` through the
+    registry.  Always returns an executable for EXACTLY this argument
+    signature; reuse layers apply only when the cache is enabled.
+
+    ``consts`` MUST cover every array/scalar the traced ``fn`` closes over
+    (it is part of the key — see module docstring); ``extra`` folds in any
+    additional statics (e.g. hyperparameters already baked into the trace
+    but not arrays, or :func:`callable_salt` of user hooks).
+    """
+    import jax
+
+    kw = jit_kwargs or {}
+    if not config.is_enabled():
+        return jax.jit(fn, **kw).lower(*args).compile()
+    from raft_tpu.utils import profiling as prof
+
+    key = aot_key(tag, args, consts=consts, mesh=mesh, extra=extra)
+    hit = _mem.get(key)
+    if hit is not None:
+        stats.record("aot", "mem_hit")
+        return hit
+    loaded = _try_load(key)
+    if loaded is not None:
+        _mem[key] = loaded
+        return loaded
+    t0 = time.perf_counter()
+    with prof.phase("cache/aot_compile", sync=False):
+        compiled = jax.jit(fn, **kw).lower(*args).compile()
+    cold_s = time.perf_counter() - t0
+    stats.record("aot", "miss")
+    _try_store(key, compiled, cold_s)
+    _mem[key] = compiled
+    return compiled
+
+
+def cached_callable(tag: str, fn, args, *, consts=(), mesh=None,
+                    jit_kwargs: dict | None = None, extra=()):
+    """Registry-backed replacement for ``jax.jit(fn, **jit_kwargs)`` at a
+    call site that immediately calls it with ``args``.
+
+    Cache disabled: returns the plain jitted function — the EXACT dispatch
+    path (and numerics) of an uncached build.  Cache enabled: returns the
+    AOT executable for this signature via :func:`cached_compile` (same
+    trace, same HLO, same results; the warm layers only skip work).
+    """
+    import jax
+
+    if not config.is_enabled():
+        return jax.jit(fn, **(jit_kwargs or {}))
+    return cached_compile(tag, fn, args, consts=consts, mesh=mesh,
+                          jit_kwargs=jit_kwargs, extra=extra)
+
+
+def clear_memory() -> None:
+    """Drop the in-process memo (tests)."""
+    _mem.clear()

@@ -1,0 +1,157 @@
+"""Warm-start enablement: one entry point wiring JAX's persistent
+compilation cache plus the on-disk layers of this package.
+
+The north-star workload spends >94% of a cold process in warm-up (XLA
+compilation + host-side BEM staging, BENCH_r05 ``phases_s``), so the
+service-shaped deployments the ROADMAP targets need compiled executables
+and staged coefficients to survive process boundaries.  ``enable()`` is the
+single switch: it points ``jax_compilation_cache_dir`` at the cache root
+and drops the min-entry-size / min-compile-time thresholds so even the
+CPU-fallback bench populates it, and it fixes the directory the AOT
+registry (:mod:`raft_tpu.cache.aot`) and the staging cache
+(:mod:`raft_tpu.cache.staging`) write under.
+
+Resolution order for the cache root:
+
+1. the ``cache_dir=`` argument;
+2. the ``RAFT_TPU_CACHE_DIR`` environment variable — the spellings
+   ``off`` / ``0`` / ``none`` / ``disabled`` (case-insensitive) disable
+   every layer, keeping the run bit-identical to an uncached one; an
+   EMPTY value means unset (fall through to the default);
+3. the default ``~/.cache/raft_tpu``.
+
+Layout under the root::
+
+    <root>/xla/       persistent XLA compilation cache (managed by jax)
+    <root>/aot/       serialized AOT executables + JSON key sidecars
+    <root>/staging/   content-addressed npz staging artifacts
+    <root>/bem/       native panel-solver results (hydro/native_bem.py)
+"""
+from __future__ import annotations
+
+import os
+
+_OFF_SPELLINGS = ("off", "0", "none", "disabled", "false", "no")
+
+_state = {"enabled": False, "dir": None, "wired": None}
+_code_salt: list = []
+
+
+def code_fingerprint() -> str:
+    """Content hash of every .py file in the raft_tpu package — the
+    in-repo analog of the user-hook ``callable_salt``: editing ANY library
+    source (physics, staging, solver driver) invalidates every AOT and
+    staging artifact, so a developer iterating on the code can never be
+    served a pre-edit executable or pre-edit staged arrays.  (The same
+    rule the native panel solver has always applied to its own source,
+    hydro/native_bem.py.)  Conservative on purpose: a docstring edit
+    recompiles too — correctness over cache lifetime.  Computed once per
+    process (~1 ms for this package size)."""
+    if not _code_salt:
+        import hashlib
+
+        import raft_tpu
+
+        h = hashlib.sha256()
+        try:
+            pkg = os.path.dirname(os.path.abspath(raft_tpu.__file__))
+            # sorted() consumes the whole walk, so ordering is already
+            # deterministic regardless of dirent order
+            for dirpath, _dirnames, filenames in sorted(os.walk(pkg)):
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        p = os.path.join(dirpath, fn)
+                        h.update(os.path.relpath(p, pkg).encode())
+                        with open(p, "rb") as f:
+                            h.update(f.read())
+            _code_salt.append(h.hexdigest()[:16])
+        except OSError:  # pragma: no cover - unreadable install
+            _code_salt.append("nosalt")
+    return _code_salt[0]
+
+
+def default_dir() -> str:
+    return os.path.join(os.path.expanduser("~"), ".cache", "raft_tpu")
+
+
+def resolve_dir(cache_dir: str | None = None) -> str | None:
+    """The cache root this process would use, or None when disabled.
+
+    Pure resolution — does not create directories or touch jax config."""
+    if cache_dir is None:
+        env = os.environ.get("RAFT_TPU_CACHE_DIR")
+        if env is not None and env.strip():
+            cache_dir = env.strip()
+        else:
+            cache_dir = default_dir()
+    if cache_dir.strip().lower() in _OFF_SPELLINGS:
+        return None
+    return os.path.abspath(os.path.expanduser(cache_dir))
+
+
+def enable(cache_dir: str | None = None,
+           min_entry_size_bytes: int = -1,
+           min_compile_time_secs: float = 0.0) -> str | None:
+    """Turn the warm-start subsystem on.  Idempotent; safe to call before
+    or after jax backend init (the compilation-cache config applies to any
+    compile that happens after the call).
+
+    Returns the cache root, or None when disabled (``RAFT_TPU_CACHE_DIR``
+    set to one of the off spellings) — in which case NOTHING is configured
+    and every cached entry point takes its plain uncached path.
+
+    ``min_entry_size_bytes=-1`` / ``min_compile_time_secs=0`` cache every
+    executable regardless of size or compile time: the north-star sweep is
+    a handful of large programs, so there is no small-entry churn to guard
+    against, and the CPU-fallback bench (fast compiles) must populate the
+    cache too for the warm-start acceptance check to be measurable
+    off-TPU.
+    """
+    root = resolve_dir(cache_dir)
+    if root is None:
+        disable()       # also un-wires a previously-enabled compile cache
+        return None
+    _state.update(enabled=True, dir=root)
+    if _state["wired"] != root:        # first call, or a new root (tests)
+        import jax
+
+        xla_dir = os.path.join(root, "xla")
+        os.makedirs(xla_dir, exist_ok=True)
+        jax.config.update("jax_compilation_cache_dir", xla_dir)
+        try:
+            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
+                              min_entry_size_bytes)
+            jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                              min_compile_time_secs)
+        except AttributeError:  # pragma: no cover - older jax spelling
+            pass
+        _state["wired"] = root
+    return root
+
+
+def disable() -> None:
+    """Turn every layer off for this process (tests): no AOT/staging
+    artifact is read or written, and the persistent compilation cache is
+    un-wired (``jax_compilation_cache_dir=None`` restores jax's
+    default-off state) so later compiles are plain uncached ones."""
+    if _state["wired"] is not None:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", None)
+        _state["wired"] = None
+    _state.update(enabled=False, dir=None)
+
+
+def is_enabled() -> bool:
+    return bool(_state["enabled"])
+
+
+def cache_dir() -> str | None:
+    return _state["dir"]
+
+
+def subdir(name: str) -> str:
+    """<root>/<name>, created on demand (caller must hold is_enabled())."""
+    d = os.path.join(_state["dir"], name)
+    os.makedirs(d, exist_ok=True)
+    return d

@@ -1,0 +1,145 @@
+"""Content-addressed on-disk cache for host-side array staging.
+
+The RAO sweep's host-side warm-up — panel meshing + BEM solve + grid
+interpolation in ``bench._volturn_setup``, WAMIT file parsing in
+``hydro.bem_io.load_wamit_coeffs``, the per-case heading interpolation in
+``parallel.sweep._stage_heading_rows`` — costs seconds per process
+(BENCH_r05 ``setup_bem_stage``: 3.08 s) and is a pure function of its
+file/array inputs.  This module memoizes such functions as npz artifacts
+keyed by a hash of everything they read: file CONTENTS (not paths/mtimes,
+so a rewritten WAMIT file invalidates and an identical copy hits), array
+bytes, and scalar/string parameters.
+
+Corruption tolerance is absolute: any failure to read or parse an artifact
+counts as a miss and falls through to the real computation (the bad file is
+replaced by the fresh store).  Writes are atomic (tmp + rename) so a killed
+process cannot leave a truncated artifact that a later run would trust.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+import time
+
+import numpy as np
+
+from raft_tpu.cache import config, stats
+
+_FORMAT_SALT = "staging-v1"       # bump to invalidate every artifact
+
+
+def _update(h, part) -> None:
+    """Fold one key part into the hash: arrays by dtype/shape/bytes,
+    file markers by content hash, scalars/strings canonically."""
+    if isinstance(part, FileKey):
+        h.update(b"file:")
+        h.update(part.digest.encode())
+    elif isinstance(part, np.ndarray) or hasattr(part, "__array__"):
+        a = np.asarray(part)
+        h.update(f"arr:{a.dtype.str}:{a.shape}:".encode())
+        h.update(np.ascontiguousarray(a).tobytes())
+    elif isinstance(part, (list, tuple)):
+        h.update(f"seq{len(part)}:".encode())
+        for p in part:
+            _update(h, p)
+    elif isinstance(part, float):
+        h.update(np.float64(part).tobytes())
+    elif isinstance(part, (int, bool, np.integer)):
+        h.update(f"int:{int(part)}:".encode())
+    elif part is None:
+        h.update(b"none:")
+    else:
+        h.update(f"str:{part}:".encode())
+
+
+class FileKey:
+    """Content identity of an input file: sha256 of its bytes.
+
+    Hashing contents (not mtime) means touching a WAMIT file without
+    changing it still hits, while any edit — including an in-place rewrite
+    that preserves size — invalidates."""
+
+    def __init__(self, path: str):
+        h = hashlib.sha256()
+        with open(path, "rb") as f:
+            for chunk in iter(lambda: f.read(1 << 20), b""):
+                h.update(chunk)
+        self.path = path
+        self.digest = h.hexdigest()
+
+
+def staging_key(category: str, *parts) -> str:
+    """Hex digest addressing one staged artifact.  The raft_tpu version
+    AND the package source fingerprint are part of the key (same
+    staleness rule as the AOT registry): an upgrade or in-repo edit that
+    changes staging semantics — interpolation, dimensionalization — must
+    recompute, not serve pre-edit arrays."""
+    import raft_tpu
+
+    h = hashlib.sha256()
+    h.update(f"{_FORMAT_SALT}:{raft_tpu.__version__}:"
+             f"{config.code_fingerprint()}:{category}:".encode())
+    for p in parts:
+        _update(h, p)
+    return h.hexdigest()[:32]
+
+
+def cached_arrays(category: str, parts, compute, meta: dict | None = None):
+    """Memoize ``compute() -> tuple of arrays`` on disk, content-addressed.
+
+    ``parts``: everything the computation reads (arrays, scalars, strings,
+    :class:`FileKey` markers for files).  Returns the tuple (complex dtypes
+    round-trip).  With the cache disabled this is exactly ``compute()``.
+
+    A hit reports the seconds it saved — the cold run stores its own
+    compute time in the artifact, so ``saved = stored_cold_s - load_s``.
+    """
+    if not config.is_enabled():
+        return tuple(compute())
+    from raft_tpu.utils import profiling as prof
+
+    key = staging_key(category, *parts)
+    path = os.path.join(config.subdir("staging"), f"{category}-{key}.npz")
+    if os.path.exists(path):
+        t0 = time.perf_counter()
+        try:
+            with prof.phase("cache/staging_load", sync=False):
+                with np.load(path, allow_pickle=False) as z:
+                    n = int(z["__n__"])
+                    cold_s = float(z["__cold_s__"])
+                    out = tuple(z[f"arr{i}"] for i in range(n))
+            load_s = time.perf_counter() - t0
+            stats.record("staging", "disk_hit",
+                         saved_s=max(0.0, cold_s - load_s))
+            return out
+        except Exception:
+            # truncated/corrupt/foreign artifact: silently recompute (the
+            # store below overwrites it atomically)
+            stats.record("staging", "error")
+    t0 = time.perf_counter()
+    out = tuple(compute())
+    cold_s = time.perf_counter() - t0
+    stats.record("staging", "miss")
+    try:
+        with prof.phase("cache/staging_save", sync=False):
+            payload = {f"arr{i}": np.asarray(a) for i, a in enumerate(out)}
+            payload["__n__"] = np.int64(len(out))
+            payload["__cold_s__"] = np.float64(cold_s)
+            if meta:
+                payload["__meta__"] = np.frombuffer(
+                    json.dumps(meta).encode(), dtype=np.uint8
+                )
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path),
+                                       suffix=".tmp")
+            try:
+                with os.fdopen(fd, "wb") as f:
+                    np.savez_compressed(f, **payload)
+                os.replace(tmp, path)
+            finally:
+                if os.path.exists(tmp):
+                    os.unlink(tmp)
+    except Exception:
+        stats.record("staging", "error")   # a failed store never fails the run
+    return out

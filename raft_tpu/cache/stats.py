@@ -1,0 +1,62 @@
+"""Warm-start instrumentation: hit/miss counters and saved-seconds ledger.
+
+Every cache layer (persistent XLA compile cache wiring, AOT executable
+registry, BEM staging cache) records its events here so a bench run can
+report the cold/warm split separately from solve throughput — the
+``warm_start`` block of the bench JSON.  Wall-clock spent inside cache
+machinery itself goes through :mod:`raft_tpu.utils.profiling` phases named
+``cache/...`` and therefore shows up in ``phases_s`` alongside the physics
+phases.
+"""
+from __future__ import annotations
+
+import threading
+from collections import defaultdict
+
+_lock = threading.Lock()
+
+
+def _zero() -> dict:
+    return {"mem_hits": 0, "disk_hits": 0, "misses": 0, "errors": 0,
+            "saved_s": 0.0}
+
+
+_layers: dict = defaultdict(_zero)
+
+_EVENT_KEY = {"mem_hit": "mem_hits", "disk_hit": "disk_hits",
+              "miss": "misses", "error": "errors"}
+
+
+def record(layer: str, event: str, saved_s: float = 0.0) -> None:
+    """Count one cache event.
+
+    ``layer``: "aot" / "staging" / ...; ``event``: "mem_hit" / "disk_hit" /
+    "miss" / "error".  ``saved_s``: estimated wall-clock the hit avoided
+    (cold compute time recorded at store time minus the load time) — the
+    number that lets the perf trajectory show warm-start value directly.
+    """
+    with _lock:
+        c = _layers[layer]
+        c[_EVENT_KEY[event]] += 1
+        c["saved_s"] += float(saved_s)
+
+
+def report() -> dict:
+    """The ``warm_start`` block: per-layer counters plus the enablement
+    state, ready to embed in a bench JSON."""
+    from raft_tpu.cache import config
+
+    with _lock:
+        layers = {k: dict(v) for k, v in _layers.items()}
+    for c in layers.values():
+        c["saved_s"] = round(c["saved_s"], 3)
+    return {
+        "enabled": config.is_enabled(),
+        "dir": config.cache_dir(),
+        **layers,
+    }
+
+
+def reset() -> None:
+    with _lock:
+        _layers.clear()

@@ -276,6 +276,13 @@ def main_optimize(argv):
 def main(argv=None):
     import sys
 
+    # warm-start subsystem: persistent XLA compile cache + AOT executable
+    # registry + BEM staging cache (RAFT_TPU_CACHE_DIR=off opts out; see
+    # docs/usage.rst "Warm starts & caching")
+    from raft_tpu import cache
+
+    cache.enable()
+
     argv = list(sys.argv[1:] if argv is None else argv)
     # subcommand dispatch; a design file literally named like a subcommand
     # still wins (analyze ./sweep by path) because existing paths short-circuit

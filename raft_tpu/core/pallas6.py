@@ -55,12 +55,21 @@ _BLOCK = 512          # systems per kernel invocation (lanes: 4 x 128)
 def enabled() -> bool:
     """True when the Pallas solve path should be used.
 
-    ``RAFT_TPU_PALLAS=1``/``true``/``on``/``yes`` forces it on (any
-    backend), ``=0``/``false``/``off``/``no`` forces it off; unset,
-    empty, or unrecognized (warned once) means **auto: on exactly when
-    the default backend is a TPU** — so a malformed value degrades to
-    the measured default instead of silently opting out of the 18x
-    TPU path.  The auto-on default is a measured decision, not a guess: on
+    Accepted spellings of ``RAFT_TPU_PALLAS`` (case-insensitive,
+    whitespace-stripped):
+
+    * force ON, any backend: ``1`` / ``true`` / ``on`` / ``yes``
+    * force OFF: ``0`` / ``false`` / ``off`` / ``no``
+    * unset -> **auto**: on exactly when the default backend is a TPU
+    * empty string or any other value -> auto, with a warning — an
+      explicitly-set-but-malformed knob degrades to the measured default
+      instead of silently opting out of the 18x TPU path.  (Before
+      round 5 the legacy rule was "anything but ``1`` means off", so a
+      deployment script exporting ``RAFT_TPU_PALLAS=""`` used to force
+      the kernel off; the warning makes that silent behavior flip
+      visible.)
+
+    The auto-on default is a measured decision, not a guess: on
     a TPU v5e the kernel ran the full 1,000-design north star 18x
     faster than the XLA lowering of the same unrolled solve (0.16 s vs
     2.9 s end-to-end, identical iteration counts, |dXi| ~ 5e-7 — the
@@ -70,7 +79,7 @@ def enabled() -> bool:
     auto stays off there and the tests' pinned-CPU runs are unaffected.
     """
     knob = os.environ.get("RAFT_TPU_PALLAS")
-    if knob:
+    if knob is not None:
         k = knob.strip().lower()
         if k in ("1", "true", "on", "yes"):
             return True
@@ -79,8 +88,14 @@ def enabled() -> bool:
         import warnings
 
         warnings.warn(
-            f"RAFT_TPU_PALLAS={knob!r} not recognized (use 1/0); "
-            f"falling back to auto (on iff the default backend is TPU)",
+            (f"RAFT_TPU_PALLAS is set but empty; treating as unset "
+             f"(auto: on iff the default backend is TPU).  The pre-round-5 "
+             f"rule forced the kernel OFF for this value — set "
+             f"RAFT_TPU_PALLAS=0 if that is what you want"
+             if not k else
+             f"RAFT_TPU_PALLAS={knob!r} not recognized "
+             f"(use 1/true/on/yes or 0/false/off/no); "
+             f"falling back to auto (on iff the default backend is TPU)"),
             stacklevel=2,
         )
     try:

@@ -159,9 +159,19 @@ def solve_bem(
         h.update(w.tobytes())
         h.update(betas.tobytes())
         h.update(np.array([rho, g, depth, float(haskind), float(n_lid)]).tobytes())
-        key = os.path.join(
-            os.path.expanduser("~/.cache/raft_tpu/bem"), h.hexdigest()[:24] + ".npz"
-        )
+        # the solver result cache predates the warm-start subsystem and is
+        # governed by this function's own ``cache`` flag, but it follows a
+        # RAFT_TPU_CACHE_DIR relocation so one root holds every layer
+        # (``off`` only disables the warm-start layers, not this one: the
+        # artifacts are exact solver output, so hits are bit-identical)
+        from raft_tpu.cache import config as _cache_config
+
+        # a programmatic enable(dir) wins over the env resolution, so one
+        # root really does hold every layer
+        root = _cache_config.cache_dir() or _cache_config.resolve_dir()
+        base = (os.path.join(root, "bem") if root is not None
+                else os.path.expanduser("~/.cache/raft_tpu/bem"))
+        key = os.path.join(base, h.hexdigest()[:24] + ".npz")
         if os.path.exists(key):
             z = np.load(key)
             out = (z["A"], z["B"], z["F"][0] if scalar_beta else z["F"])

@@ -254,9 +254,27 @@ def optimize_design(
     loss = _make_loss(members, rna, env, wave, C_moor, objective, apply_fn,
                       bem, n_iter, remat, case_reduce=case_reduce,
                       moor=moor, moor_apply_fn=moor_apply_fn, r6_moor=r6_moor)
-    val_grad = jax.jit(jax.value_and_grad(loss))
-
     theta = jnp.asarray(theta0, dtype=float)
+    # AOT registry: the value-and-grad step is ONE large executable reused
+    # for every optimizer iteration AND across processes (warm co-design
+    # restarts skip the whole backward-pass compile); plain jit when the
+    # cache is off — today's exact path
+    from raft_tpu import cache as _cache
+
+    val_grad = _cache.cached_callable(
+        "optimize_design/val_grad", jax.value_and_grad(loss), (theta,),
+        consts=(members, rna, env, wave, C_moor,
+                bem if bem is not None else (),
+                moor if moor is not None else (),
+                r6_moor if r6_moor is not None else ()),
+        extra=("n_iter", n_iter, "remat", remat,
+               *_cache.callable_salt(objective),
+               *_cache.callable_salt(apply_fn),
+               *(_cache.callable_salt(case_reduce)
+                 if case_reduce is not None else ("case_reduce=max",)),
+               *(_cache.callable_salt(moor_apply_fn)
+                 if moor_apply_fn is not None else ("moor_apply=none",))),
+    )
     opt_state = optimizer.init(theta)
     history, thetas = [], [theta]
     g_norm = 0.0
@@ -299,4 +317,20 @@ def grad_nacelle_accel_std(
     derivative of the ``case_reduce`` (default worst-case) statistic."""
     loss = _make_loss(members, rna, env, wave, C_moor, nacelle_accel_std,
                       apply_fn, bem, n_iter, remat, case_reduce=case_reduce)
-    return jax.grad(loss)(jnp.asarray(theta, dtype=float))
+    from raft_tpu import cache as _cache
+
+    theta = jnp.asarray(theta, dtype=float)
+    if _cache.is_enabled():
+        # with the cache armed the gradient runs as ONE registered
+        # executable; off, it keeps today's un-jitted eager-grad path
+        g = _cache.cached_compile(
+            "grad_nacelle_accel_std", jax.grad(loss), (theta,),
+            consts=(members, rna, env, wave, C_moor,
+                    bem if bem is not None else ()),
+            extra=("n_iter", n_iter, "remat", remat,
+                   *_cache.callable_salt(apply_fn),
+                   *(_cache.callable_salt(case_reduce)
+                     if case_reduce is not None else ("case_reduce=max",))),
+        )
+        return g(theta)
+    return jax.grad(loss)(theta)

@@ -9,6 +9,12 @@ import os
 # NOTE: the environment may pin JAX_PLATFORMS to a hardware plugin via
 # sitecustomize; jax.config.update below takes precedence over the env var.
 os.environ["JAX_PLATFORMS"] = "cpu"
+# the warm-start subsystem defaults ON in the CLI/bench entry points; pin
+# it OFF for the suite so every test runs the plain uncached paths (seed
+# semantics, no artifacts under ~/.cache).  tests/test_cache.py opts back
+# in per-test with an explicit tmp dir (an explicit enable(dir) argument
+# overrides this env pin).
+os.environ["RAFT_TPU_CACHE_DIR"] = "off"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

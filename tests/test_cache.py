@@ -1,0 +1,405 @@
+"""Warm-start subsystem tests (raft_tpu/cache): keying, corruption
+tolerance, staging invalidation, off-path identity, cross-process smoke.
+
+The suite-wide conftest pins ``RAFT_TPU_CACHE_DIR=off`` so every other
+test runs the plain uncached paths; each test here opts in with an
+explicit tmp cache dir (an explicit ``enable(dir)`` argument overrides
+the env pin) and restores the disabled state on teardown.  Everything
+runs under ``JAX_PLATFORMS=cpu`` — the subsystem is backend-agnostic by
+construction (backend/device-kind are key salts, not requirements).
+"""
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import cache
+from raft_tpu.cache import aot, config, staging, stats
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def warm(tmp_path):
+    """Cache armed at a fresh dir; disabled + reset after the test."""
+    root = cache.enable(str(tmp_path / "cache"))
+    stats.reset()
+    aot.clear_memory()
+    yield root
+    cache.disable()
+    aot.clear_memory()
+    stats.reset()
+
+
+# ------------------------------------------------------------- enablement
+
+
+def test_resolve_dir_spellings(monkeypatch):
+    for off in ("off", "OFF", "0", "none", "disabled", "Disabled", "no"):
+        assert config.resolve_dir(off) is None
+        monkeypatch.setenv("RAFT_TPU_CACHE_DIR", off)
+        assert config.resolve_dir() is None
+    # empty env means UNSET (default dir), matching the RAFT_TPU_PALLAS
+    # empty-knob convention
+    monkeypatch.setenv("RAFT_TPU_CACHE_DIR", "")
+    assert config.resolve_dir() == os.path.abspath(config.default_dir())
+    monkeypatch.setenv("RAFT_TPU_CACHE_DIR", "/some/where")
+    assert config.resolve_dir() == "/some/where"
+    # the explicit argument wins over the env pin
+    assert config.resolve_dir("/else/where") == "/else/where"
+
+
+def test_enable_off_is_noop(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_CACHE_DIR", "off")
+    assert cache.enable() is None
+    assert not cache.is_enabled()
+
+
+# ---------------------------------------------------------------- staging
+
+
+def test_staging_roundtrip_hit_and_key(warm):
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return (np.arange(6.0), np.ones((2, 3)) * (1 + 2j))
+
+    parts = ("tag", np.arange(4.0), 2.5, 7, None)
+    a1, c1 = staging.cached_arrays("t", parts, compute)
+    a2, c2 = staging.cached_arrays("t", parts, compute)       # disk hit
+    assert len(calls) == 1
+    np.testing.assert_array_equal(a1, a2)
+    np.testing.assert_array_equal(c1, c2)
+    assert c2.dtype == np.complex128                # complex round-trips
+    rep = stats.report()["staging"]
+    assert rep["disk_hits"] == 1 and rep["misses"] == 1
+    # any changed key part is a different artifact
+    staging.cached_arrays("t", ("tag", np.arange(4.0), 2.5, 8, None), compute)
+    assert len(calls) == 2
+    assert staging.staging_key("t", *parts) != staging.staging_key(
+        "t", "tag", np.arange(4.0), 2.5, 8, None)
+
+
+def test_staging_corruption_tolerance(warm):
+    calls = []
+
+    def compute():
+        calls.append(1)
+        return (np.full(3, 7.0),)
+
+    (out,) = staging.cached_arrays("c", ("k",), compute)
+    d = os.path.join(warm, "staging")
+    (art,) = [f for f in os.listdir(d) if f.startswith("c-")]
+    with open(os.path.join(d, art), "wb") as f:
+        f.write(b"truncated garbage")                # corrupt the artifact
+    (out2,) = staging.cached_arrays("c", ("k",), compute)    # silent recompute
+    assert len(calls) == 2
+    np.testing.assert_array_equal(out, out2)
+    assert stats.report()["staging"]["errors"] == 1
+    (out3,) = staging.cached_arrays("c", ("k",), compute)    # healed: hits again
+    assert len(calls) == 2
+    np.testing.assert_array_equal(out, out3)
+
+
+def test_wamit_staging_invalidates_on_file_change(warm, tmp_path):
+    from test_bem_io import synth_wamit
+
+    from raft_tpu.hydro.bem_io import load_wamit_coeffs
+
+    w, A, B, Xre, Xim, p1, p3 = synth_wamit(tmp_path)
+    grid = np.linspace(0.25, 0.95, 8)
+    A1, B1, F1 = load_wamit_coeffs(p1, p3, grid)
+    A2, B2, F2 = load_wamit_coeffs(p1, p3, grid)         # content hit
+    np.testing.assert_array_equal(A1, A2)
+    np.testing.assert_array_equal(F1, F2)
+    assert stats.report()["staging"]["disk_hits"] == 1
+    # rewrite the .1 file with scaled coefficients: the content hash (not
+    # mtime) must invalidate and the fresh parse must see the new values
+    txt = open(p1).read().splitlines()
+    with open(p1, "w") as f:
+        for ln in txt:
+            c = ln.split()
+            f.write(f"{c[0]} {c[1]} {c[2]} {float(c[3]) * 2:.12E} {c[4]}\n")
+    A3, B3, F3 = load_wamit_coeffs(p1, p3, grid)
+    np.testing.assert_allclose(A3, 2 * A1, rtol=1e-9)
+    np.testing.assert_array_equal(B3, B1)
+    assert stats.report()["staging"]["misses"] == 2
+
+
+# -------------------------------------------------------------------- aot
+
+
+def test_aot_keying_shape_dtype_consts_mesh(warm):
+    x32 = jnp.zeros((4, 3), jnp.float32)
+    x64 = jnp.zeros((4, 3), jnp.float64)
+    y = jnp.zeros((8, 3), jnp.float32)
+    k = aot.aot_key("t", (x32,))
+    assert k == aot.aot_key("t", (x32,))                 # deterministic
+    assert k != aot.aot_key("u", (x32,))                 # tag
+    assert k != aot.aot_key("t", (y,))                   # shape
+    assert k != aot.aot_key("t", (x64,))                 # dtype
+    assert k != aot.aot_key("t", (x32,), consts=(np.ones(3),))   # consts
+    assert (aot.aot_key("t", (x32,), consts=(np.ones(3),))
+            != aot.aot_key("t", (x32,), consts=(2 * np.ones(3),)))  # content
+    from raft_tpu.parallel import make_mesh
+
+    assert k != aot.aot_key("t", (x32,), mesh=make_mesh(2))      # topology
+    assert (aot.aot_key("t", (x32,), mesh=make_mesh(2))
+            != aot.aot_key("t", (x32,), mesh=make_mesh(4)))
+
+
+def test_callable_salt_sees_closure_values():
+    """Two instances of the same factory-made hook differ only in the
+    captured value — the salt must distinguish them, or a warm process
+    would reuse an executable with the WRONG constant baked in."""
+    def make_apply(alpha):
+        def apply(m, t):
+            return m * alpha * t
+        return apply
+
+    assert aot.callable_salt(make_apply(0.5)) != aot.callable_salt(
+        make_apply(2.0))
+    assert aot.callable_salt(make_apply(0.5)) == aot.callable_salt(
+        make_apply(0.5))
+
+    def make_arr(a):
+        def f(x):
+            return x + a
+        return f
+
+    assert aot.callable_salt(make_arr(np.ones(3))) != aot.callable_salt(
+        make_arr(np.zeros(3)))
+
+
+def test_bench_stderr_tail_redaction():
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod_redact", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+    for s in ("Authorization: Bearer sk-ant-SECRET123",
+              "Bearer tok_abc123",
+              "api_key=XYZ999",
+              "oops sk-ant-api03-longsecret99 trace"):
+        out = bench._stderr_tail(s)
+        assert "SECRET" not in out and "tok_abc" not in out \
+            and "XYZ999" not in out and "longsecret" not in out, (s, out)
+    assert bench._stderr_tail("plain diagnostic line") == \
+        "plain diagnostic line"
+    # a credential whose key prefix sits before the 300-char cut must
+    # still be caught (redaction happens before truncation)
+    s = "x" * 500 + "Authorization: Bearer " + "A" * 290
+    assert "AAAA" not in bench._stderr_tail(s)
+
+
+def test_disable_unwires_compile_cache(tmp_path):
+    cache.enable(str(tmp_path / "c"))
+    assert jax.config.jax_compilation_cache_dir is not None
+    cache.disable()
+    assert jax.config.jax_compilation_cache_dir is None
+    # enable with an off spelling after a prior enable must un-wire too
+    cache.enable(str(tmp_path / "c"))
+    assert cache.enable("off") is None
+    assert jax.config.jax_compilation_cache_dir is None
+    assert not cache.is_enabled()
+
+
+def test_keys_salted_by_package_source(warm, monkeypatch):
+    """Editing ANY in-repo source must invalidate both registries — a
+    developer iterating on physics code can never be served a pre-edit
+    executable or pre-edit staged arrays."""
+    x = jnp.zeros(3)
+    k_aot = aot.aot_key("t", (x,))
+    k_stage = staging.staging_key("t", np.arange(3.0))
+    monkeypatch.setattr(config, "_code_salt", ["deadbeefdeadbeef"])
+    assert aot.aot_key("t", (x,)) != k_aot
+    assert staging.staging_key("t", np.arange(3.0)) != k_stage
+
+
+def test_aot_key_version_salted(warm, monkeypatch):
+    x = jnp.zeros(3)
+    k = aot.aot_key("t", (x,))
+    monkeypatch.setattr(aot, "_version_salts",
+                        lambda: ("jax=9.9.9", "jaxlib=9.9.9", "raft_tpu=x"))
+    assert aot.aot_key("t", (x,)) != k       # a jax upgrade invalidates
+
+
+def test_aot_mem_disk_and_corruption(warm):
+    x = jnp.arange(8.0)
+
+    def f(v):
+        return (v * 3 + 1).sum()
+
+    c1 = aot.cached_compile("toy", f, (x,))
+    ref = c1(x)
+    assert stats.report()["aot"]["misses"] == 1
+    assert aot.cached_compile("toy", f, (x,))(x) == ref          # mem hit
+    assert stats.report()["aot"]["mem_hits"] == 1
+    aot.clear_memory()
+    c2 = aot.cached_compile("toy", f, (x,))                      # disk hit
+    assert stats.report()["aot"]["disk_hits"] == 1
+    assert c2(x) == ref
+    # corrupt the stored executable: silent recompile, never a crash
+    aot.clear_memory()
+    d = os.path.join(warm, "aot")
+    (art,) = os.listdir(d)
+    with open(os.path.join(d, art), "wb") as f2:
+        f2.write(b"\x00garbage")
+    c3 = aot.cached_compile("toy", f, (x,))
+    assert c3(x) == ref
+    rep = stats.report()["aot"]
+    assert rep["errors"] == 1 and rep["misses"] == 2
+
+
+def test_cached_callable_off_is_plain_jit():
+    cache.disable()
+    x = jnp.ones(4)
+    fn = aot.cached_callable("t", lambda v: v + 1, (x,))
+    # the disabled path must be today's exact dispatch path: a jitted
+    # function (re-traceable on new shapes), NOT a shape-locked executable
+    np.testing.assert_array_equal(fn(jnp.ones(9)), np.full(9, 2.0))
+
+
+# ------------------------------------------------- end-to-end sweep paths
+
+
+def _tiny_sweep():
+    import __graft_entry__ as ge
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+    from raft_tpu.parallel import sweep
+
+    design, members, rna, env, wave = ge._base(nw=16)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    return sweep(members, rna, env, wave, C_moor,
+                 jnp.linspace(0.97, 1.03, 2), n_iter=20)
+
+
+def test_sweep_cache_on_equals_off(warm):
+    on1 = _tiny_sweep()
+    on2 = _tiny_sweep()                       # mem hit, same executable
+    cache.disable()
+    off = _tiny_sweep()
+    np.testing.assert_array_equal(on1["std dev"], off["std dev"])
+    np.testing.assert_array_equal(on1["std dev"], on2["std dev"])
+    np.testing.assert_array_equal(on1["Xi_abs2"], off["Xi_abs2"])
+
+
+def test_sweep_sea_states_cache_on_equals_off(warm):
+    import __graft_entry__ as ge
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+    from raft_tpu.parallel import make_wave_states, sweep_sea_states
+
+    design, members, rna, env, wave = ge._base(nw=16)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    waves = make_wave_states(np.asarray(wave.w), [[6, 10], [8, 12]],
+                             float(env.depth))
+    on = sweep_sea_states(members, rna, env, waves, C_moor, n_iter=20)
+    cache.disable()
+    off = sweep_sea_states(members, rna, env, waves, C_moor, n_iter=20)
+    np.testing.assert_array_equal(on["std dev"], off["std dev"])
+    np.testing.assert_array_equal(on["Xi_abs2"], off["Xi_abs2"])
+    cache.enable(warm)
+    on2 = sweep_sea_states(members, rna, env, waves, C_moor, n_iter=20)
+    np.testing.assert_array_equal(on["std dev"], on2["std dev"])
+    assert stats.report()["aot"]["mem_hits"] >= 1
+
+
+def _oc3_inputs(nw=16):
+    import __graft_entry__ as ge
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+
+    design, members, rna, env, wave = ge._base(nw=nw)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"]
+    )
+    return members, rna, env, wave, mooring_stiffness(moor, jnp.zeros(6))
+
+
+def test_freq_sharded_and_dp_sp_cache_paths(warm):
+    """The sharded forwards' AOT path: deterministic across repeat calls
+    (committed placement + stored executable) and matching the plain
+    eager-shard_map path to reduction-order tolerance — the extra jit
+    wrapper the registry needs reassociates at float-eps level, exactly
+    the tolerance the sharded==unsharded docstring already grants."""
+    from jax.sharding import Mesh
+
+    members, rna, env, wave, C_moor = _oc3_inputs()
+    from raft_tpu.parallel import (
+        forward_response_dp_sp, forward_response_freq_sharded, make_mesh,
+    )
+
+    mesh_f = make_mesh(8, axis="freq")
+    on1 = forward_response_freq_sharded(members, rna, env, wave, C_moor,
+                                        mesh_f, n_iter=30)
+    on2 = forward_response_freq_sharded(members, rna, env, wave, C_moor,
+                                        mesh_f, n_iter=30)
+    np.testing.assert_array_equal(np.asarray(on1.Xi.re),
+                                  np.asarray(on2.Xi.re))
+    cache.disable()
+    off = forward_response_freq_sharded(members, rna, env, wave, C_moor,
+                                        mesh_f, n_iter=30)
+    np.testing.assert_allclose(np.asarray(on1.Xi.re), np.asarray(off.Xi.re),
+                               rtol=1e-10, atol=1e-12)
+    cache.enable(warm)
+
+    mesh2 = Mesh(np.array(jax.devices()).reshape(2, 4), ("designs", "freq"))
+    th = jnp.linspace(0.97, 1.03, 2)
+    on_dp = forward_response_dp_sp(members, rna, env, wave, C_moor, th,
+                                   mesh2, n_iter=30)
+    cache.disable()
+    off_dp = forward_response_dp_sp(members, rna, env, wave, C_moor, th,
+                                    mesh2, n_iter=30)
+    np.testing.assert_allclose(np.asarray(on_dp.Xi.re),
+                               np.asarray(off_dp.Xi.re),
+                               rtol=1e-10, atol=1e-12)
+
+
+def test_optimize_val_grad_cache_on_equals_off(warm):
+    """optimize_design's value-and-grad step compiles from the SAME trace
+    either way (plain jit off, registry executable on) — results must be
+    bit-identical, and the registry must log the executable."""
+    members, rna, env, wave, C_moor = _oc3_inputs(nw=12)
+    from raft_tpu.parallel import optimize_design
+
+    kw = dict(theta0=jnp.ones(1), steps=2, learning_rate=0.02, n_iter=12)
+    on = optimize_design(members, rna, env, wave, C_moor, **kw)
+    assert stats.report()["aot"]["misses"] >= 1
+    cache.disable()
+    off = optimize_design(members, rna, env, wave, C_moor, **kw)
+    np.testing.assert_array_equal(on.history, off.history)
+    np.testing.assert_array_equal(on.thetas, off.thetas)
+
+
+# ------------------------------------------------------ cross-process smoke
+
+
+def test_cache_smoke_two_processes(tmp_path):
+    """The ``make cache-smoke`` check, smallest workload: a second PROCESS
+    must load the stored executable (disk hit) and spend well under the
+    cold process's compile time — the acceptance-criteria warm start,
+    verified in the driver's regime (fresh subprocesses)."""
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.cache", "smoke",
+         "--n", "2", "--nw", "12", "--threshold", "0.6",
+         "--dir", str(tmp_path / "smoke")],
+        capture_output=True, text=True, timeout=420, cwd=REPO,
+        env={**os.environ, "RAFT_TPU_CACHE_DIR": ""},
+    )
+    assert r.returncode == 0, (r.stdout + r.stderr)[-2000:]
+    out = json.loads(r.stdout.strip().splitlines()[-1])
+    assert out["ok"] and out["warm_aot_disk_hits"] >= 1
+    assert out["results_identical"]
+    assert out["warm_compile_s"] < 0.6 * out["cold_compile_s"]

@@ -119,3 +119,20 @@ def test_spawn_full_bench_guards(tmp_path, monkeypatch):
     monkeypatch.setattr(bench.sys, "executable", fake_child("sleep 60"))
     out, err = bench._spawn_full_bench({}, 2.0)
     assert out is None and err["class"] == "DeviceBenchTimeout"
+
+    # 5. stdout that parses as JSON but is not a result dict ('null', a
+    # number, a stray list) -> an error dict with the stderr diagnostic,
+    # never an exception out of the rescue path
+    for payload in ("echo null", "echo 42", "echo '[1, 2]'"):
+        monkeypatch.setattr(bench.sys, "executable", fake_child(payload))
+        out, err = bench._spawn_full_bench({}, 30.0)
+        assert out is None and err["class"] == "DeviceBenchFailed"
+
+    # 6. a crashed child's stderr tail is surfaced (and redacted)
+    monkeypatch.setattr(
+        bench.sys, "executable",
+        fake_child("echo 'Trace: api_key=SEKRET died' >&2; echo notjson"))
+    out, err = bench._spawn_full_bench({}, 30.0)
+    assert out is None and "stderr_tail" in err
+    assert "SEKRET" not in err["stderr_tail"]
+    assert "died" in err["stderr_tail"]

@@ -159,7 +159,12 @@ def test_enabled_knob_parsing(monkeypatch):
         warnings.simplefilter("always")
         assert pallas6.enabled() is auto
     assert any("RAFT_TPU_PALLAS" in str(r.message) for r in rec)
-    monkeypatch.setenv("RAFT_TPU_PALLAS", "")     # empty: auto, no warning
-    assert pallas6.enabled() is auto
+    # empty means SET-but-malformed: auto, with a warning (the pre-round-5
+    # rule forced the kernel off for "", so the flip must be visible)
+    monkeypatch.setenv("RAFT_TPU_PALLAS", "")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        assert pallas6.enabled() is auto
+    assert any("empty" in str(r.message) for r in rec)
     monkeypatch.delenv("RAFT_TPU_PALLAS")
     assert pallas6.enabled() is auto

@@ -2,10 +2,13 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke
+.PHONY: fast test evidence bench dryrun cache-smoke lint
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
+
+lint:            ## graftlint: static rules vs baseline + trace audit
+	python -m raft_tpu.lint --audit
 
 cache-smoke:     ## warm-start proof: tiny sweep twice in fresh processes,
 	python -m raft_tpu.cache smoke   # 2nd run's compile must be < 50% of 1st
@@ -19,5 +22,5 @@ dryrun:          ## 8-device multi-chip dry run (the driver's check)
 bench:           ## benchmark; prints one JSON line
 	python bench.py
 
-evidence:        ## fast tier + dryrun + bench -> EVIDENCE.json
+evidence:        ## fast tier + lint + dryrun + bench -> EVIDENCE.json
 	python -m raft_tpu.evidence

@@ -236,7 +236,11 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
         compiled = cache.cached_compile(
             "bench.north_star", jax.vmap(one), (scales[0],),
             consts=(members, rna, env, wave, C_moor, bem),
-            extra=("n_iter", 40, "method", "while"),
+            # bench.py sits OUTSIDE the package code_fingerprint walk, so
+            # the traced closure must salt the key itself: an edit to
+            # `one` may never be served a pre-edit executable
+            extra=("n_iter", 40, "method", "while",
+                   *cache.callable_salt(one)),
         )
     flops_chunk = _flops_per_call(compiled)
 
@@ -365,7 +369,9 @@ def oc3_strip_throughput(batch: int = 2048, nw: int = 200, reps: int = 3):
         fwd = cache.cached_callable(
             "bench.oc3_strip", jax.vmap(one), (scales,),
             consts=(members, rna, env, wave, C_moor),
-            extra=("n_iter", 40, "method", "while"),
+            # out-of-package closure: salt the key (see bench.north_star)
+            extra=("n_iter", 40, "method", "while",
+                   *cache.callable_salt(one)),
         )
     out, conv = fwd(scales)
     out.block_until_ready()                       # compile + warm cache

@@ -79,6 +79,31 @@ def _topology(mesh=None) -> tuple:
     return topo
 
 
+def _update_code_consts(h, consts, _depth: int = 0) -> None:
+    """Hash a code object's literal constants STRUCTURALLY: nested code
+    objects (lambdas, comprehensions, inner defs) hash by their own
+    bytecode + constants, never by ``repr`` — a code object's repr embeds
+    its memory address, which would make the salt process-unique and
+    silently defeat the cross-process disk layer for any hook containing
+    a lambda."""
+    import types
+
+    for c in consts:
+        if isinstance(c, types.CodeType):
+            if _depth < 8:
+                h.update(c.co_code)
+                _update_code_consts(h, c.co_consts, _depth + 1)
+            else:  # pragma: no cover - pathological nesting
+                h.update(b"<code:deep>")
+        elif isinstance(c, (frozenset, set)):
+            # unordered: iteration (and so repr) order follows the
+            # per-process PYTHONHASHSEED — canonicalize or the salt is
+            # process-unique for any hook containing `x in {"a", "b"}`
+            h.update(("{%s}" % ",".join(sorted(map(repr, c)))).encode())
+        else:
+            h.update(repr(c).encode())
+
+
 def callable_salt(fn, _depth: int = 0) -> tuple:
     """Best-effort identity of a user-supplied callable for the key:
     qualified name + source hash + a fingerprint of its closure cells.
@@ -107,7 +132,7 @@ def callable_salt(fn, _depth: int = 0) -> tuple:
         # even when no source is retrievable (REPL / exec-defined lambdas,
         # where getsource raises for both)
         h.update(code.co_code)
-        h.update(repr(code.co_consts).encode())
+        _update_code_consts(h, code.co_consts)
     cells = getattr(fn, "__closure__", None) or ()
     for cell in cells:
         try:

@@ -3,10 +3,13 @@
 ``python -m raft_tpu.evidence`` runs, in order:
 
 1. the fast test tier (``pytest -m "not slow"``),
-2. the multi-chip dry run (``__graft_entry__.dryrun_multichip(8)``) in a
+2. graftlint (``python -m raft_tpu.lint --audit``: static rules vs the
+   committed baseline + the trace-audit budgets over every registered
+   entry point),
+3. the multi-chip dry run (``__graft_entry__.dryrun_multichip(8)``) in a
    fresh subprocess under the same kind of wall-clock budget the driver
    applies,
-3. ``bench.py`` (device if reachable, labeled CPU fallback otherwise),
+4. ``bench.py`` (device if reachable, labeled CPU fallback otherwise),
 
 and writes ``EVIDENCE.json`` at the repo root with one entry per artifact
 (ok flag, rc, wall-clock, output tail).  Purpose: "passes locally but red
@@ -16,6 +19,7 @@ because each step runs in the same fresh-subprocess regime the driver
 uses (no shared jax state with the invoking process).
 
 Knobs (env): ``RAFT_EVIDENCE_SKIP_TESTS=1`` to skip the test tier,
+``RAFT_EVIDENCE_LINT_TIMEOUT`` (s, default 600),
 ``RAFT_EVIDENCE_DRYRUN_TIMEOUT`` (s, default 300),
 ``RAFT_EVIDENCE_BENCH_TIMEOUT`` (s, default 1800).
 """
@@ -61,6 +65,21 @@ def main():
              "-p", "no:cacheprovider"],
             timeout=1800, label="tests_fast",
         )
+
+    print("[evidence] graftlint (static + trace audit) ...", flush=True)
+    lint = _run(
+        [sys.executable, "-m", "raft_tpu.lint", "--audit", "--json"],
+        timeout=float(os.environ.get("RAFT_EVIDENCE_LINT_TIMEOUT", "600")),
+        label="lint",
+    )
+    # the CLI's --json line is the last stdout line; embed it when present
+    for line in reversed(lint.pop("stdout_tail", [])):
+        try:
+            lint["json"] = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    evidence["lint"] = lint
 
     print("[evidence] dryrun_multichip(8) ...", flush=True)
     evidence["multichip"] = _run(

@@ -66,7 +66,9 @@ def read_capy_nc(path: str, wDes=None, heading_idx: int = 0,
         fEx_all = (D[0] + FK[0]) + 1j * (D[1] + FK[1])
     else:
         fEx_all = D[0] + 1j * D[1]
-    fEx = fEx_all[:, heading_idx, :][:, ii].T.astype(np.complex128)
+    # Capytaine hands back float64/complex128; keep the HOST staging layout
+    # canonical — the device layout downcasts at jnp.asarray time (x32)
+    fEx = fEx_all[:, heading_idx, :][:, ii].T.astype(np.complex128)  # graftlint: disable=GL105
 
     if wDes is not None:
         wDes = np.asarray(wDes, dtype=float)
@@ -135,7 +137,8 @@ def call_capy(meshFName: str, wCapy, CoG=(0.0, 0.0, 0.0), headings=(0.0,),
     A = ds["added_mass"].values.transpose(1, 2, 0)
     B = ds["radiation_damping"].values.transpose(1, 2, 0)
     fEx = (ds["diffraction_force"] + ds["Froude_Krylov_force"]).values
-    fEx = fEx[:, 0, :].T.astype(np.complex128)
+    # host staging layout (see run_capytaine above): canonical c128 on host
+    fEx = fEx[:, 0, :].T.astype(np.complex128)  # graftlint: disable=GL105
     return np.asarray(wCapy), A, B, fEx
 
 

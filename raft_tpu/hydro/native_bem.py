@@ -11,6 +11,9 @@ next to the source; results are cached content-addressed (mesh + grid hash)
 under ``~/.cache/raft_tpu/bem`` — the formalization of the reference's
 compute-once/reuse WAMIT-file pattern (SURVEY.md §5 checkpoint/resume).
 """
+# graftlint: disable-file=GL105 — the C++ ABI is `double*`: every array
+# crossing the ctypes boundary MUST be float64; nothing here reaches the
+# device without a jnp.asarray downcast on the staging side.
 from __future__ import annotations
 
 import ctypes

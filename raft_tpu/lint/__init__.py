@@ -1,0 +1,26 @@
+"""graftlint: JAX-aware static analysis + trace audit for raft_tpu.
+
+Two complementary passes keep the hot path recompile-free and dtype-clean:
+
+* the **static pass** (:mod:`raft_tpu.lint.rules`) — AST rules GL101-GL107
+  over the package source: numpy-on-tracer, host casts, traced Python
+  branches, ``static_argnames`` hazards, float64 literals, host syncs in
+  jitted code, nondeterministic set/listdir iteration near cache keys;
+* the **trace audit** (:mod:`raft_tpu.lint.audit`) — abstractly traces
+  every registered public entry point (north-star sweep, DLC solve,
+  frequency-sharded forward, co-design val_grad, eigen) under
+  ``jax.make_jaxpr`` and asserts per-jaxpr budgets: zero retraces for a
+  repeated same-shape call, zero float64 leaves under x32, zero host
+  callbacks.
+
+CLI: ``python -m raft_tpu.lint [--audit] [--write-baseline] [paths...]``
+(exit 0 clean, 1 on new violations / budget breaches).  A committed
+baseline (``raft_tpu/lint/baseline.json``) triages pre-existing findings:
+only violations NOT in the baseline fail the run.  Suppression syntax and
+the rule catalog are documented in ``docs/lint.rst``.
+"""
+from raft_tpu.lint.rules import (  # noqa: F401
+    RULES,
+    Violation,
+    lint_paths,
+)

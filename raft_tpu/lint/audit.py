@@ -1,0 +1,183 @@
+"""Trace audit: per-jaxpr budgets for the registered entry points.
+
+For every :mod:`raft_tpu.lint.registry` entry the audit
+
+1. traces the entry under ``jax.make_jaxpr`` **in x32 mode** (the TPU
+   production mode; ``jax.experimental.disable_x64`` scopes it even when
+   the enclosing test session runs x64) and walks the closed jaxpr —
+   including every nested sub-jaxpr (pjit/scan/while/cond/shard_map
+   bodies) — asserting
+
+   * a **dtype budget**: zero ``float64``/``complex128`` avals.  A leak
+     means some constant or op re-promoted the x32 pipeline — exactly the
+     hazard class GL105 guards statically;
+   * a **host-callback budget**: zero ``pure_callback``/``io_callback``/
+     ``debug_callback`` equations.  A callback inside the hot loop syncs
+     host<->device every iteration and makes the executable
+     unserializable for the AOT registry (cache/aot.py);
+
+2. runs a **retrace check**: ``jax.jit`` the entry, call it with two
+   same-shape/same-dtype argument sets, and count actual traces via a
+   counting wrapper.  The budget is ONE trace — a second trace for
+   identical abstract signatures means something non-hashable or
+   value-dependent leaked into the trace (the recompile hazard that
+   erases the warm-start wins: PR 1 measured >94% of cold wall-clock in
+   XLA compilation).
+
+``run_audit()`` returns one :class:`AuditReport` per entry;
+``main``-level consumers (CLI ``--audit``, ``make lint``, the fast test
+tier) fail on any ``ok=False`` report.
+"""
+from __future__ import annotations
+
+import dataclasses
+import time
+
+_HOST_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
+                        "callback"}
+_WIDE_DTYPES = ("float64", "complex128")
+
+
+@dataclasses.dataclass
+class AuditReport:
+    name: str
+    public_api: str
+    n_eqns: int                 # equations in the flattened jaxpr walk
+    f64_leaves: int             # wide-dtype avals found (budget: 0)
+    f64_examples: list          # first few offending aval descriptions
+    host_callbacks: int         # callback eqns found (budget: 0)
+    retraces: int               # extra traces on a same-shape call (0)
+    trace_s: float
+    ok: bool
+
+    def to_dict(self) -> dict:
+        d = dataclasses.asdict(self)
+        d["trace_s"] = round(d["trace_s"], 3)
+        return d
+
+    def summary(self) -> str:
+        state = "ok" if self.ok else "FAIL"
+        return (f"[audit] {self.name}: {state} — {self.n_eqns} eqns, "
+                f"f64 leaves {self.f64_leaves}, host callbacks "
+                f"{self.host_callbacks}, retraces {self.retraces} "
+                f"({self.trace_s:.2f}s)")
+
+
+def _iter_jaxprs(jaxpr):
+    """Yield ``jaxpr`` and every sub-jaxpr reachable through eqn params
+    (pjit/scan/while/cond/shard_map/custom_vjp bodies, remat, ...)."""
+    import jax.core as jcore
+
+    seen: set[int] = set()
+    stack = [jaxpr]
+    while stack:
+        j = stack.pop()
+        if id(j) in seen:
+            continue
+        seen.add(id(j))
+        yield j
+        for eqn in j.eqns:
+            for val in eqn.params.values():
+                stack.extend(_extract_jaxprs(val, jcore))
+
+
+def _extract_jaxprs(val, jcore):
+    out = []
+    if isinstance(val, jcore.ClosedJaxpr):
+        out.append(val.jaxpr)
+    elif isinstance(val, jcore.Jaxpr):
+        out.append(val)
+    elif isinstance(val, (list, tuple)):
+        for v in val:
+            out.extend(_extract_jaxprs(v, jcore))
+    return out
+
+
+def _aval_is_wide(aval) -> bool:
+    dt = getattr(aval, "dtype", None)
+    return dt is not None and str(dt) in _WIDE_DTYPES
+
+
+def audit_jaxpr(closed_jaxpr):
+    """(n_eqns, f64_leaves, f64_examples, host_callbacks) over the full
+    nested-jaxpr walk."""
+    n_eqns = 0
+    wide = 0
+    examples: list[str] = []
+    callbacks = 0
+    for j in _iter_jaxprs(closed_jaxpr.jaxpr):
+        for var in list(j.invars) + list(j.constvars) + list(j.outvars):
+            aval = getattr(var, "aval", None)
+            if aval is not None and _aval_is_wide(aval):
+                wide += 1
+                if len(examples) < 4:
+                    examples.append(f"var {aval}")
+        for eqn in j.eqns:
+            n_eqns += 1
+            if eqn.primitive.name in _HOST_CALLBACK_PRIMS:
+                callbacks += 1
+            for var in eqn.outvars:
+                aval = getattr(var, "aval", None)
+                if aval is not None and _aval_is_wide(aval):
+                    wide += 1
+                    if len(examples) < 4:
+                        examples.append(f"{eqn.primitive.name} -> {aval}")
+    # consts of the top-level closed jaxpr (closure-captured arrays)
+    for c in closed_jaxpr.consts:
+        dt = getattr(c, "dtype", None)
+        if dt is not None and str(dt) in _WIDE_DTYPES:
+            wide += 1
+            if len(examples) < 4:
+                examples.append(f"const {dt}{getattr(c, 'shape', ())}")
+    return n_eqns, wide, examples, callbacks
+
+
+def _count_retraces(fn, args, args2) -> int:
+    """Extra traces beyond the first when calling a fresh ``jax.jit`` of
+    ``fn`` with two same-structure argument sets."""
+    import jax
+
+    traces = [0]
+
+    def counted(*a):
+        traces[0] += 1
+        return fn(*a)
+
+    jf = jax.jit(counted)
+    r1 = jf(*args)
+    r2 = jf(*args2)
+    jax.block_until_ready((r1, r2))
+    return traces[0] - 1
+
+
+def audit_entry(entry, retrace_check: bool = True) -> AuditReport:
+    """Run all budgets for one registry entry **in x32 mode**."""
+    import jax
+    from jax.experimental import disable_x64
+
+    t0 = time.perf_counter()
+    with disable_x64():
+        fn, args, args2 = entry.build()
+        jaxpr = jax.make_jaxpr(fn)(*args)
+        n_eqns, wide, examples, callbacks = audit_jaxpr(jaxpr)
+        retraces = (_count_retraces(fn, args, args2)
+                    if retrace_check else 0)
+    return AuditReport(
+        name=entry.name,
+        public_api=entry.public_api,
+        n_eqns=n_eqns,
+        f64_leaves=wide,
+        f64_examples=examples,
+        host_callbacks=callbacks,
+        retraces=retraces,
+        trace_s=time.perf_counter() - t0,
+        ok=(wide == 0 and callbacks == 0 and retraces == 0),
+    )
+
+
+def run_audit(names=None, retrace_check: bool = True) -> list[AuditReport]:
+    """Audit the named entries (default: every registered entry)."""
+    from raft_tpu.lint.registry import get_entries
+
+    return [audit_entry(e, retrace_check=retrace_check)
+            for e in get_entries(names)]

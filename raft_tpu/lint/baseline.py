@@ -1,0 +1,64 @@
+"""Committed-baseline triage for graftlint.
+
+The baseline is a JSON map ``fingerprint -> count`` of violations that
+existed when the linter landed (or were consciously triaged later).  A
+run fails only on violations NOT covered by the baseline, so the gate
+can merge with a dirty tree and still stop every regression.
+
+Fingerprints (see :meth:`raft_tpu.lint.rules.Violation.fingerprint`) are
+line-number-free — rule + file + enclosing function + stripped source
+text — so reformatting elsewhere in a file does not churn the baseline.
+``python -m raft_tpu.lint --write-baseline`` regenerates the file;
+review the diff like any other code change.
+"""
+from __future__ import annotations
+
+import json
+import os
+from collections import Counter
+
+from raft_tpu.lint.rules import Violation
+
+DEFAULT_BASELINE = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                                "baseline.json")
+
+
+def load(path: str | None = None) -> Counter:
+    path = path or DEFAULT_BASELINE
+    if not os.path.exists(path):
+        return Counter()
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    return Counter({str(k): int(v) for k, v in
+                    data.get("violations", {}).items()})
+
+
+def save(violations: list[Violation], path: str | None = None) -> str:
+    path = path or DEFAULT_BASELINE
+    counts = Counter(v.fingerprint() for v in violations)
+    payload = {
+        "_comment": "graftlint baseline: fingerprint -> count of triaged "
+                    "pre-existing violations; regenerate with "
+                    "`python -m raft_tpu.lint --write-baseline`",
+        "violations": {k: counts[k] for k in sorted(counts)},
+    }
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(payload, f, indent=1)
+        f.write("\n")
+    return path
+
+
+def filter_new(violations: list[Violation],
+               path: str | None = None) -> tuple[list[Violation], int]:
+    """(violations not covered by the baseline, number baselined-out)."""
+    budget = Counter(load(path))
+    fresh: list[Violation] = []
+    absorbed = 0
+    for v in violations:
+        fp = v.fingerprint()
+        if budget.get(fp, 0) > 0:
+            budget[fp] -= 1
+            absorbed += 1
+        else:
+            fresh.append(v)
+    return fresh, absorbed

@@ -1,0 +1,128 @@
+"""``python -m raft_tpu.lint``: the graftlint command line.
+
+Modes (composable):
+
+* default — static AST pass over the package (GL101-GL107), compared
+  against the committed baseline; exit 1 on any NEW violation;
+* ``--audit`` — additionally run the trace audit over the registered
+  entry points (retrace / f64 / host-callback budgets); exit 1 on any
+  budget breach;
+* ``--write-baseline`` — regenerate the baseline from the current tree
+  (triage mode) and exit 0;
+* ``--json`` — emit one machine-readable JSON line (the form
+  ``make evidence`` embeds in EVIDENCE.json) after the human output.
+
+Paths default to the package + repo entry scripts.  Tests and fixture
+corpora are deliberately NOT linted: the suite runs x64 on purpose, and
+``tests/test_lint.py``'s fixtures must contain violations.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+DEFAULT_TARGETS = ("raft_tpu", "__graft_entry__.py", "bench.py")
+
+
+def repo_root() -> str:
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(
+        prog="python -m raft_tpu.lint",
+        description="graftlint: JAX-aware static analysis + trace audit")
+    ap.add_argument("paths", nargs="*", default=None,
+                    help="files/dirs to lint (default: the package + "
+                         "entry scripts)")
+    ap.add_argument("--root", default=None,
+                    help="repo root for relative paths (default: "
+                         "autodetected)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: raft_tpu/lint/"
+                         "baseline.json)")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="regenerate the baseline from the current tree")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="report every violation, ignoring the baseline")
+    ap.add_argument("--audit", action="store_true",
+                    help="also run the trace audit (registered entry "
+                         "points)")
+    ap.add_argument("--audit-only", action="store_true",
+                    help="run only the trace audit")
+    ap.add_argument("--audit-entries", default=None,
+                    help="comma-separated registry entry names "
+                         "(default: all)")
+    ap.add_argument("--no-retrace-check", action="store_true",
+                    help="audit jaxpr budgets only (skip the compile the "
+                         "retrace check needs)")
+    ap.add_argument("--json", action="store_true",
+                    help="emit a final machine-readable JSON line")
+    args = ap.parse_args(argv)
+
+    root = args.root or repo_root()
+    rc = 0
+    summary: dict = {"tool": "graftlint"}
+
+    if not args.audit_only:
+        from raft_tpu.lint import baseline as bl
+        from raft_tpu.lint.rules import lint_paths
+
+        targets = list(args.paths) if args.paths else list(DEFAULT_TARGETS)
+        try:
+            violations = lint_paths(targets, root)
+        except (FileNotFoundError, ValueError) as e:
+            # a typo'd target must fail LOUD, not lint nothing and pass
+            print(f"[graftlint] error: {e}")
+            return 2
+        if args.write_baseline:
+            path = bl.save(violations, args.baseline)
+            print(f"[graftlint] baseline written: {path} "
+                  f"({len(violations)} violations triaged)")
+            summary["static"] = {"violations": len(violations),
+                                 "baseline_written": True}
+        else:
+            if args.no_baseline:
+                fresh, absorbed = violations, 0
+            else:
+                fresh, absorbed = bl.filter_new(violations, args.baseline)
+            for v in fresh:
+                print(v.format())
+            print(f"[graftlint] static: {len(fresh)} new violation(s), "
+                  f"{absorbed} baselined, "
+                  f"{len(violations)} total")
+            summary["static"] = {"new": len(fresh), "baselined": absorbed,
+                                 "total": len(violations)}
+            if fresh:
+                rc = 1
+
+    if (args.audit or args.audit_only) and not args.write_baseline:
+        # the audit is a structural gate, not a perf run: default it onto
+        # CPU (the test-suite convention — no hardware required) unless
+        # the caller pinned a platform explicitly
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from raft_tpu.lint.audit import run_audit
+
+        names = (args.audit_entries.split(",")
+                 if args.audit_entries else None)
+        reports = run_audit(names,
+                            retrace_check=not args.no_retrace_check)
+        for r in reports:
+            print(r.summary())
+        bad = [r for r in reports if not r.ok]
+        summary["audit"] = {"entries": [r.to_dict() for r in reports],
+                            "failed": len(bad)}
+        if bad:
+            rc = 1
+
+    summary["ok"] = rc == 0
+    if args.json:
+        print(json.dumps(summary))
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

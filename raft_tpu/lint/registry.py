@@ -1,0 +1,202 @@
+"""Registered public entry points for the trace audit.
+
+Each entry mirrors the *traced core* of one public API — the exact
+function shape the public orchestrator hands to ``jax.jit`` — built on a
+deliberately tiny OC3-spar model (small ``nw``, few fixed-point
+iterations) so the audit traces in milliseconds and the one compile the
+retrace check needs stays cheap on CPU.
+
+Why mirrors and not the orchestrators themselves: ``sweep`` /
+``sweep_sea_states`` / ``optimize_design`` are host-side functions that
+stage arrays, pick shardings, and consult the warm-start cache before
+jitting their core — jitting the orchestrator would itself be a lint
+violation (host ``np.asarray`` on the inputs).  The registry builds the
+same vmapped/shard_mapped core the orchestrator jits, with the same
+``n_iter``/``method`` semantics, so a hazard introduced into the traced
+pipeline (statics -> Morison -> drag-linearized solve) shows up here.
+
+Every entry returns ``(fn, args, args2)``: two argument pytrees with
+IDENTICAL structure/shapes/dtypes but different values.  The audit
+asserts that calling ``jit(fn)`` with both causes exactly one trace —
+the "repeated same-shape north-star sweep call must not retrace"
+acceptance gate.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable
+
+# (nw, x64-mode) -> staged base model; the audit traces under x32 while
+# the test suite runs x64, so the cache must key on the mode
+_base_cache: dict = {}
+
+
+@dataclasses.dataclass(frozen=True)
+class EntryPoint:
+    name: str
+    public_api: str                      # the API this entry guards
+    build: Callable[[], tuple]           # () -> (fn, args, args2)
+
+
+def _small_base(nw: int = 6):
+    """Tiny OC3-spar staging shared by all entries (host-side, cheap) —
+    the same :func:`raft_tpu.model.stage_design_base` recipe the driver
+    entry uses, just on a smaller frequency grid."""
+    import jax
+
+    from raft_tpu.model import stage_design_base
+
+    key = (nw, bool(jax.config.jax_enable_x64))
+    hit = _base_cache.get(key)
+    if hit is not None:
+        return hit
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    out = stage_design_base(os.path.join(pkg, "designs", "OC3spar.yaml"),
+                            nw=nw, Hs=6.0, Tp=10.0, w_min=0.3, w_max=2.1)
+    _base_cache[key] = out
+    return out
+
+
+_N_ITER = 3     # fixed-point iterations: the audit checks structure, not
+#                 convergence, so the cheapest deterministic scan suffices
+
+
+def _entry_north_star_sweep():
+    """Traced core of :func:`raft_tpu.parallel.sweep.sweep` — the
+    north-star design-batch RAO sweep (vmapped forward_response over a
+    theta batch, ``method='scan'``)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.parallel.sweep import forward_response, scale_diameters
+
+    _, members, rna, env, wave, C_moor = _small_base()
+
+    def one(theta):
+        m = scale_diameters(members, theta)
+        out = forward_response(m, rna, env, wave, C_moor, n_iter=_N_ITER,
+                               method="scan")
+        return out.Xi.abs2(), out.n_iter
+
+    fn = jax.vmap(one)
+    args = (1.0 + 0.02 * jnp.arange(2),)
+    args2 = (1.0 + 0.03 * jnp.arange(2),)
+    return fn, args, args2
+
+
+def _entry_dlc_solve():
+    """Traced core of :func:`raft_tpu.parallel.sweep.sweep_sea_states` —
+    the DLC-table evaluation (per-case drag linearization under vmap)."""
+    import jax
+
+    from raft_tpu.parallel.optimize import nacelle_accel_std
+    from raft_tpu.parallel.sweep import forward_response, make_wave_states
+
+    design, members, rna, env, wave, C_moor = _small_base()
+    import numpy as np
+
+    depth = float(design["mooring"]["water_depth"])
+    waves = make_wave_states(np.asarray(wave.w), [[6.0, 10.0], [8.0, 12.0]],
+                             depth)
+    waves2 = make_wave_states(np.asarray(wave.w), [[5.0, 9.0], [9.0, 13.0]],
+                              depth)
+
+    def one(wv):
+        out = forward_response(members, rna, env, wv, C_moor,
+                               n_iter=_N_ITER)
+        return out.Xi.abs2(), nacelle_accel_std(out.Xi, wv, rna), out.n_iter
+
+    return jax.vmap(one), (waves,), (waves2,)
+
+
+def _entry_freq_sharded():
+    """Traced core of
+    :func:`raft_tpu.parallel.sweep.forward_response_freq_sharded` — the
+    sequence-parallel shard_map solve (psum/pmax collectives per
+    iteration); audited on a 1-device mesh so the audit runs identically
+    under the CLI (1 CPU device) and the test suite (8 virtual devices)."""
+    from raft_tpu.parallel.sweep import (
+        forward_response_freq_sharded, make_mesh,
+    )
+
+    _, members, rna, env, wave, C_moor = _small_base()
+    mesh = make_mesh(1, axis="freq")
+
+    def fn(wv):
+        out = forward_response_freq_sharded(
+            members, rna, env, wv, C_moor, mesh=mesh,
+            n_iter=_N_ITER, method="scan")
+        return out.Xi.abs2()
+
+    wave2 = wave.replace(zeta=wave.zeta * 1.1)
+    return fn, (wave,), (wave2,)
+
+
+def _entry_val_grad():
+    """Traced core of :func:`raft_tpu.parallel.optimize.optimize_design`'s
+    per-step executable — ``jax.value_and_grad`` of the nacelle-accel
+    objective through the reverse-differentiable scan driver."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.parallel.optimize import nacelle_accel_std
+    from raft_tpu.parallel.sweep import forward_response, scale_diameters
+
+    _, members, rna, env, wave, C_moor = _small_base()
+
+    def loss(theta):
+        m = scale_diameters(members, theta)
+        out = forward_response(m, rna, env, wave, C_moor, n_iter=_N_ITER,
+                               method="scan")
+        return nacelle_accel_std(out.Xi, wave, rna)
+
+    fn = jax.value_and_grad(loss)
+    return fn, (jnp.asarray(1.0),), (jnp.asarray(1.05),)
+
+
+def _entry_eigen():
+    """Traced core of :func:`raft_tpu.solve.eigen.solve_eigen` — the
+    generalized symmetric eigensolve (Cholesky + Jacobi sweeps)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.solve.eigen import solve_eigen
+    from raft_tpu.statics import assemble_statics
+
+    _, members, rna, env, _, C_moor = _small_base()
+    stat = assemble_statics(members, rna, env)
+    M = stat.M_struc
+    C = stat.C_struc + stat.C_hydro + C_moor
+    # same matrices, different well-posed values for the retrace check
+    M2 = M + 0.01 * jnp.eye(6, dtype=M.dtype) * M[0, 0]
+    C2 = C + 0.01 * jnp.eye(6, dtype=C.dtype) * jnp.abs(C[2, 2])
+
+    def fn(Mx, Cx_):
+        return solve_eigen(Mx, Cx_)
+
+    return fn, (M, C), (M2, C2)
+
+
+ENTRY_POINTS: tuple[EntryPoint, ...] = (
+    EntryPoint("north_star_sweep", "raft_tpu.parallel.sweep.sweep",
+               _entry_north_star_sweep),
+    EntryPoint("dlc_solve", "raft_tpu.parallel.sweep.sweep_sea_states",
+               _entry_dlc_solve),
+    EntryPoint("freq_sharded_forward",
+               "raft_tpu.parallel.sweep.forward_response_freq_sharded",
+               _entry_freq_sharded),
+    EntryPoint("val_grad", "raft_tpu.parallel.optimize.optimize_design",
+               _entry_val_grad),
+    EntryPoint("eigen", "raft_tpu.solve.eigen.solve_eigen", _entry_eigen),
+)
+
+
+def get_entries(names=None) -> tuple[EntryPoint, ...]:
+    if names is None:
+        return ENTRY_POINTS
+    by_name = {e.name: e for e in ENTRY_POINTS}
+    missing = [n for n in names if n not in by_name]
+    if missing:
+        raise KeyError(f"unknown audit entries {missing}; have "
+                       f"{sorted(by_name)}")
+    return tuple(by_name[n] for n in names)

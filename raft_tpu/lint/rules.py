@@ -1,0 +1,1025 @@
+"""graftlint rules engine: JAX/TPU-aware AST static analysis.
+
+The hazard classes this pass exists for are the ones that silently erase
+the warm-start wins measured in PR 1 (>94% of cold wall-clock is XLA
+compilation): code patterns that force avoidable retraces, promote the
+x32 hot path to float64, or synchronize host<->device inside a jitted
+program.  None of them raise at import time, and only some raise under
+trace — the rest just make the sweep slow, which is why they need a
+static pass.
+
+Rule IDs (each documented with rationale + example in ``docs/lint.rst``):
+
+=======  ====================  ==============================================
+GL101    numpy-on-tracer       ``np.*`` call receives a traced value inside a
+                               jit-reachable function (constant-folds at
+                               trace time at best, ``TracerArrayConversion``
+                               at worst)
+GL102    host-cast-on-tracer   ``float()/int()/bool()/complex()`` applied to
+                               a traced value (forces a device sync, breaks
+                               under ``vmap``)
+GL103    traced-python-branch  ``if``/``while``/``assert``/``for``/ternary
+                               on a traced value (trace-time specialization:
+                               either a ConcretizationTypeError or a silent
+                               retrace per branch)
+GL104    static-arg-hazard     ``static_argnames``/``static_argnums`` naming
+                               a missing parameter, an array-typed parameter
+                               (retrace per VALUE), or an unhashable default
+GL105    float64-literal       explicit ``float64``/``complex128`` dtype
+                               that defeats the x32 path
+GL106    host-sync-in-jit      ``.item()``/``.tolist()``/``print``/
+                               ``np.asarray``/``device_get``/
+                               ``block_until_ready`` inside jit-reachable
+                               code
+GL107    nondeterministic-     iteration over a ``set`` (or unsorted
+         iteration             ``os.listdir``) where the order can feed
+                               compiled-program structure or cache keys
+=======  ====================  ==============================================
+
+Reachability: a function is *jit-reachable* when it is decorated with (or
+passed to) a tracing transform — ``jit``/``vmap``/``grad``/``shard_map``/
+``lax.scan``/... — or is called (or referenced) from the body of another
+jit-reachable function, including across modules through ``from X import
+y`` edges.  Parameters of reachable functions are considered traced unless
+they are listed in ``static_argnames`` or annotated as plain Python
+scalars (``int``/``bool``/``str``); names assigned from traced names
+become traced (shape/dtype/``is None`` inspections do not propagate
+taint, because they are static under trace).
+
+Suppression: append ``# graftlint: disable=GL101`` (comma-separate for
+several rules, ``all`` for every rule) to the flagged line, or put
+``# graftlint: disable-file=GL105`` on its own line anywhere in the file
+to suppress a rule file-wide.  Suppressions are for *justified* host-side
+uses — e.g. ``np.float64`` canonicalization inside a cache-key hasher.
+"""
+from __future__ import annotations
+
+import ast
+import dataclasses
+import hashlib
+import os
+import re
+
+RULES = {
+    "GL101": "numpy-on-tracer",
+    "GL102": "host-cast-on-tracer",
+    "GL103": "traced-python-branch",
+    "GL104": "static-arg-hazard",
+    "GL105": "float64-literal",
+    "GL106": "host-sync-in-jit",
+    "GL107": "nondeterministic-iteration",
+}
+
+# transforms whose function argument is traced with abstract values
+_TRACING_TRANSFORMS = {
+    "jit", "vmap", "pmap", "grad", "value_and_grad", "jacfwd", "jacrev",
+    "jvp", "vjp", "linearize", "hessian", "checkpoint", "remat",
+    "custom_jvp", "custom_vjp", "shard_map", "scan", "while_loop", "cond",
+    "switch", "fori_loop", "map", "associative_scan", "make_jaxpr",
+    "named_call", "pallas_call",
+}
+
+# names valid only under the lax namespace: ``jax.tree.map`` is a HOST
+# function and must not alias to ``lax.map``
+_LAX_ONLY_TRANSFORMS = {"scan", "while_loop", "cond", "switch",
+                        "fori_loop", "map", "associative_scan"}
+
+# attribute bases under which a transform name is accepted (after alias
+# resolution): jax.X, lax.X, jax.lax.X, pallas.X, shard_map module, ...
+_JAXY_BASES = {"jax", "lax", "experimental", "pallas", "shard_map",
+               "pjit", "ad_checkpoint", "checkpoint"}
+
+# attribute/function inspections that are static under trace: a traced
+# name appearing only inside these does NOT make the expression traced
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "sharding", "aval"}
+_STATIC_CALLS = {"len", "isinstance", "type", "hasattr", "getattr",
+                 "ndim", "shape", "result_type", "issubdtype", "treedef",
+                 "tree_structure"}
+
+# numpy functions that are pure host-constant producers and legitimately
+# appear in traced code when fed only non-traced values (handled by the
+# taint check anyway; listed for documentation)
+_HOST_SYNC_METHODS = {"item", "tolist", "block_until_ready"}
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*graftlint:\s*disable(?P<file>-file)?\s*=\s*"
+    r"(?P<rules>[A-Za-z0-9_,\s]+)"
+)
+
+# annotations marking a parameter as static Python configuration rather
+# than trace data: scalars, device meshes, and user callables
+_SCALAR_ANNOTATIONS = {"int", "bool", "str", "Mesh", "Callable"}
+
+
+@dataclasses.dataclass(frozen=True)
+class Violation:
+    rule: str
+    path: str          # repo-relative path
+    line: int
+    col: int
+    func: str          # enclosing function qualname, or "<module>"
+    msg: str
+    source: str = ""   # stripped source line (baseline fingerprint input)
+
+    def format(self) -> str:
+        return (f"{self.path}:{self.line}:{self.col}: {self.rule} "
+                f"[{RULES[self.rule]}] {self.msg}")
+
+    def fingerprint(self) -> str:
+        """Line-number-free identity used by the committed baseline: the
+        rule + file + enclosing function + the stripped source text.  A
+        pure reformat elsewhere in the file cannot churn the baseline."""
+        h = hashlib.sha256(
+            f"{self.rule}|{self.path}|{self.func}|{self.source}".encode()
+        ).hexdigest()[:16]
+        return f"{self.rule}:{self.path}:{h}"
+
+
+@dataclasses.dataclass
+class FuncInfo:
+    node: ast.AST                      # FunctionDef / AsyncFunctionDef / Lambda
+    qualname: str
+    module: "ModuleInfo"
+    parent: "FuncInfo | None"
+    params: list[str] = dataclasses.field(default_factory=list)
+    static_params: set[str] = dataclasses.field(default_factory=set)
+    is_root: bool = False
+    reachable: bool = False
+
+
+class ModuleInfo:
+    """Per-file AST plus resolved aliases and the local function table."""
+
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.lines = source.splitlines()
+        self.tree = ast.parse(source, filename=path)
+        self.numpy_aliases: set[str] = set()
+        self.jnp_aliases: set[str] = set()
+        self.jax_aliases: set[str] = set()
+        self.lax_aliases: set[str] = set()
+        self.os_aliases: set[str] = set()
+        self.partial_names: set[str] = set()
+        self.functools_aliases: set[str] = set()
+        # bare name -> transform name (e.g. from jax import vmap)
+        self.transform_names: dict[str, str] = {}
+        # local name -> (dotted module, attr-or-None) for cross-module edges
+        self.import_map: dict[str, tuple[str, str | None]] = {}
+        self.functions: dict[str, FuncInfo] = {}
+        self.lambda_infos: dict[int, FuncInfo] = {}   # id(node) -> info
+        # names bound to numpy/jnp float64/complex128 via from-imports
+        self.wide_dtype_names: dict[str, str] = {}
+        self.file_suppress: set[str] = set()
+        self.line_suppress: dict[int, set[str]] = {}
+        self._collect_suppressions()
+        self._collect_imports()
+
+    # -- suppressions ---------------------------------------------------
+    def _collect_suppressions(self) -> None:
+        for i, line in enumerate(self.lines, start=1):
+            m = _SUPPRESS_RE.search(line)
+            if not m:
+                continue
+            rules = {r.strip().upper() for r in m.group("rules").split(",")
+                     if r.strip()}
+            if "ALL" in rules:
+                rules = set(RULES)
+            if m.group("file"):
+                self.file_suppress |= rules
+            else:
+                self.line_suppress.setdefault(i, set()).update(rules)
+
+    def suppressed(self, rule: str, line: int) -> bool:
+        if rule in self.file_suppress:
+            return True
+        return rule in self.line_suppress.get(line, set())
+
+    # -- imports --------------------------------------------------------
+    def _collect_imports(self) -> None:
+        for node in ast.walk(self.tree):
+            if isinstance(node, ast.Import):
+                for al in node.names:
+                    name = al.asname or al.name.split(".")[0]
+                    if al.name == "numpy":
+                        self.numpy_aliases.add(al.asname or "numpy")
+                    elif al.name == "jax.numpy":
+                        if al.asname:
+                            self.jnp_aliases.add(al.asname)
+                        self.jax_aliases.add("jax")
+                    elif al.name == "jax":
+                        self.jax_aliases.add(al.asname or "jax")
+                    elif al.name == "functools":
+                        self.functools_aliases.add(al.asname or "functools")
+                    elif al.name == "os":
+                        self.os_aliases.add(al.asname or "os")
+                    else:
+                        self.import_map[name] = (al.name, None)
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for al in node.names:
+                    name = al.asname or al.name
+                    if mod in ("numpy", "jax.numpy"):
+                        if al.name in ("float64", "complex128"):
+                            # `from numpy import float64` — bare-name uses
+                            # are flagged by the GL105 Name check
+                            self.wide_dtype_names[name] = al.name
+                        self.import_map[name] = (mod, al.name)
+                    elif mod == "jax" and al.name == "numpy":
+                        self.jnp_aliases.add(name)
+                    elif mod == "functools" and al.name == "partial":
+                        self.partial_names.add(name)
+                    elif al.name in _TRACING_TRANSFORMS and (
+                            mod == "jax" or mod.startswith("jax.")):
+                        self.transform_names[name] = al.name
+                    elif mod == "jax" and al.name == "lax":
+                        self.lax_aliases.add(name)
+                    else:
+                        self.import_map[name] = (mod, al.name)
+
+    # -- name classification --------------------------------------------
+    def is_numpy(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id in self.numpy_aliases
+
+    def is_jnp(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name) and node.id in self.jnp_aliases:
+            return True
+        return (isinstance(node, ast.Attribute) and node.attr == "numpy"
+                and self.is_jax(node.value))
+
+    def is_jax(self, node: ast.AST) -> bool:
+        return isinstance(node, ast.Name) and node.id in self.jax_aliases
+
+    def transform_of(self, func: ast.AST) -> str | None:
+        """Transform name when ``func`` is a tracing transform, else None.
+
+        Discriminates by the immediate namespace so host-side lookalikes
+        (``jax.tree.map``, ``jax.tree_util.tree_map``) are NOT transforms
+        while ``jax.lax.map``/``lax.scan``/``pl.pallas_call`` are."""
+        if isinstance(func, ast.Name):
+            return self.transform_names.get(func.id)
+        if not isinstance(func, ast.Attribute) or \
+                func.attr not in _TRACING_TRANSFORMS:
+            return None
+        base = func.value
+        # classify the immediate base namespace
+        if self.is_jax(base):
+            return None if func.attr in _LAX_ONLY_TRANSFORMS else func.attr
+        if isinstance(base, ast.Name):
+            if base.id in self.lax_aliases:
+                return func.attr
+            # alias of a jax submodule (e.g. pl -> jax.experimental.pallas,
+            # functools excluded): accept non-lax-only transforms
+            tgt = self.import_map.get(base.id)
+            if tgt is not None and tgt[0].startswith("jax"):
+                last = (tgt[1] or tgt[0]).rsplit(".", 1)[-1]
+                if last in _JAXY_BASES or func.attr == "pallas_call":
+                    return (None if func.attr in _LAX_ONLY_TRANSFORMS
+                            and last != "lax" else func.attr)
+            return None
+        if isinstance(base, ast.Attribute):
+            # dotted chain: jax.lax.scan vs jax.tree.map — judge by the
+            # component immediately before the transform name
+            if base.attr in _JAXY_BASES and (
+                    self.is_jax(_attr_root(base))
+                    or _attr_root_name(base) in self.lax_aliases
+                    or _attr_root_name(base) in self.jax_aliases):
+                if func.attr in _LAX_ONLY_TRANSFORMS and base.attr != "lax":
+                    return None
+                return func.attr
+        return None
+
+    def is_partial(self, func: ast.AST) -> bool:
+        if isinstance(func, ast.Name) and func.id in self.partial_names:
+            return True
+        return (isinstance(func, ast.Attribute) and func.attr == "partial"
+                and isinstance(func.value, ast.Name)
+                and func.value.id in self.functools_aliases)
+
+
+def _attr_root(node: ast.Attribute) -> ast.AST:
+    while isinstance(node, ast.Attribute):
+        node = node.value
+    return node
+
+
+def _attr_root_name(node: ast.AST) -> str | None:
+    root = _attr_root(node) if isinstance(node, ast.Attribute) else node
+    return root.id if isinstance(root, ast.Name) else None
+
+
+def _param_names(args: ast.arguments) -> list[str]:
+    names = [a.arg for a in args.posonlyargs + args.args + args.kwonlyargs]
+    if args.vararg:
+        names.append(args.vararg.arg)
+    if args.kwarg:
+        names.append(args.kwarg.arg)
+    return names
+
+
+def _annotation_is_scalar(ann: ast.AST | None) -> bool:
+    """True for ``int``/``bool``/``str`` (incl. ``int | None`` unions):
+    a scalar-annotated parameter is static configuration, not a tracer."""
+    if ann is None:
+        return False
+    if isinstance(ann, ast.Name):
+        return ann.id in _SCALAR_ANNOTATIONS
+    if isinstance(ann, ast.Constant) and isinstance(ann.value, str):
+        parts = re.split(r"[\[\]|,\s]+", ann.value)
+        return any(p in _SCALAR_ANNOTATIONS for p in parts) and not any(
+            p in ("Array", "ndarray") for p in parts)
+    if isinstance(ann, ast.BinOp) and isinstance(ann.op, ast.BitOr):
+        return (_annotation_is_scalar(ann.left)
+                or _annotation_is_scalar(ann.right))
+    if isinstance(ann, ast.Subscript):  # Optional[int] etc.
+        return _annotation_is_scalar(ann.slice)
+    return False
+
+
+def _annotation_is_array(ann: ast.AST | None) -> bool:
+    if ann is None:
+        return False
+    text = ast.dump(ann)
+    return ("Array" in text) or ("ndarray" in text)
+
+
+def _literal_static_names(call: ast.Call) -> tuple[set[str], list[ast.AST]]:
+    """(static_argnames as strings, static_argnums nodes) of a jit call."""
+    names: set[str] = set()
+    nums: list[ast.AST] = []
+    for kw in call.keywords:
+        if kw.arg == "static_argnames":
+            for n in ast.walk(kw.value):
+                if isinstance(n, ast.Constant) and isinstance(n.value, str):
+                    names.add(n.value)
+        elif kw.arg == "static_argnums":
+            nums.append(kw.value)
+    return names, nums
+
+
+class _FunctionCollector(ast.NodeVisitor):
+    """First pass: record every function def with its qualname + params."""
+
+    def __init__(self, mod: ModuleInfo):
+        self.mod = mod
+        self.stack: list[FuncInfo] = []
+
+    def _visit_func(self, node):
+        parent = self.stack[-1] if self.stack else None
+        prefix = parent.qualname + "." if parent else ""
+        qualname = prefix + node.name
+        fi = FuncInfo(node=node, qualname=qualname, module=self.mod,
+                      parent=parent, params=_param_names(node.args))
+        # scalar-annotated params are static configuration
+        for a in (node.args.posonlyargs + node.args.args
+                  + node.args.kwonlyargs):
+            if _annotation_is_scalar(a.annotation):
+                fi.static_params.add(a.arg)
+        self._apply_decorators(fi, node)
+        self.mod.functions[qualname] = fi
+        self.stack.append(fi)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    visit_FunctionDef = _visit_func
+    visit_AsyncFunctionDef = _visit_func
+
+    def visit_Lambda(self, node: ast.Lambda):
+        parent = self.stack[-1] if self.stack else None
+        prefix = parent.qualname + "." if parent else ""
+        qualname = f"{prefix}<lambda:{node.lineno}:{node.col_offset}>"
+        fi = FuncInfo(node=node, qualname=qualname, module=self.mod,
+                      parent=parent, params=_param_names(node.args))
+        self.mod.functions[qualname] = fi
+        self.mod.lambda_infos[id(node)] = fi
+        self.stack.append(fi)
+        self.generic_visit(node)
+        self.stack.pop()
+
+    def _apply_decorators(self, fi: FuncInfo, node) -> None:
+        for dec in node.decorator_list:
+            tname = self.mod.transform_of(dec)
+            if tname:
+                fi.is_root = True
+                continue
+            if isinstance(dec, ast.Call):
+                # @partial(jax.jit, static_argnames=...)
+                if self.mod.is_partial(dec.func) and dec.args:
+                    inner = self.mod.transform_of(dec.args[0])
+                    if inner:
+                        fi.is_root = True
+                        names, _ = _literal_static_names(dec)
+                        fi.static_params |= names
+                # @jax.jit(static_argnames=...)
+                elif self.mod.transform_of(dec.func):
+                    fi.is_root = True
+                    names, _ = _literal_static_names(dec)
+                    fi.static_params |= names
+
+
+class Analyzer:
+    """Whole-package analysis: reachability propagation + rule checks."""
+
+    def __init__(self, paths: list[str], root: str):
+        self.root = root
+        self.modules: dict[str, ModuleInfo] = {}     # dotted name -> info
+        self.by_relpath: dict[str, ModuleInfo] = {}
+        self.violations: list[Violation] = []
+        for p in paths:
+            rel = os.path.relpath(p, root)
+            try:
+                with open(p, "r", encoding="utf-8") as f:
+                    src = f.read()
+                mod = ModuleInfo(p, rel, src)
+            except SyntaxError as e:
+                self.violations.append(Violation(
+                    rule="GL103", path=rel, line=e.lineno or 0, col=0,
+                    func="<module>", msg=f"file does not parse: {e.msg}",
+                    source=""))
+                continue
+            _FunctionCollector(mod).visit(mod.tree)
+            self.modules[_dotted_name(rel)] = mod
+            self.by_relpath[rel] = mod
+
+    # -- cross-module resolution ----------------------------------------
+    def resolve_external(self, mod: ModuleInfo, name: str) -> list[FuncInfo]:
+        """Resolve ``name`` through ``mod``'s imports to FuncInfos in other
+        analyzed modules (package ``__init__`` re-exports are chased by
+        searching the package directory)."""
+        target = mod.import_map.get(name)
+        if target is None:
+            return []
+        dotted, attr = target
+        fname = attr or name
+        out: list[FuncInfo] = []
+        # exact module
+        m = self.modules.get(dotted)
+        if m is not None and fname in m.functions:
+            out.append(m.functions[fname])
+        if not out:
+            # package: search every analyzed module under that prefix
+            for dn, m2 in self.modules.items():
+                if dn == dotted or dn.startswith(dotted + "."):
+                    fi = m2.functions.get(fname)
+                    if fi is not None:
+                        out.append(fi)
+        return out
+
+    def resolve_local(self, mod: ModuleInfo, scope: FuncInfo | None,
+                      name: str) -> FuncInfo | None:
+        """Resolve a bare name to a function visible from ``scope``:
+        nested siblings first, then enclosing scopes, then module scope."""
+        chain = []
+        fi = scope
+        while fi is not None:
+            chain.append(fi.qualname + ".")
+            fi = fi.parent
+        chain.append("")
+        for prefix in chain:
+            hit = mod.functions.get(prefix + name)
+            if hit is not None:
+                return hit
+        return None
+
+    # -- reachability ----------------------------------------------------
+    def propagate(self) -> None:
+        work: list[FuncInfo] = []
+
+        def mark(fi: FuncInfo | None) -> None:
+            if fi is not None and not fi.reachable:
+                fi.reachable = True
+                work.append(fi)
+
+        for mod in self.modules.values():
+            for fi in mod.functions.values():
+                if fi.is_root:
+                    mark(fi)
+            # functions passed to transforms anywhere (incl. inside host
+            # orchestrators): jax.jit(f) / vmap(one) / scan(body, ...) —
+            # resolved in the call's own lexical scope, so a nested
+            # ``def one`` passed to ``jax.vmap`` inside its parent is found
+            for scope, call in self._transform_calls(mod):
+                for arg in list(call.args) + [k.value
+                                              for k in call.keywords]:
+                    if isinstance(arg, ast.Lambda):
+                        mark(mod.lambda_infos.get(id(arg)))
+                    else:
+                        for fi in self._funcs_named_in(mod, scope, arg):
+                            mark(fi)
+            # factory pattern: a nested def returned BY NAME is a closure
+            # whose callers typically hand it to a transform
+            # (``loss = _make_loss(...); jax.value_and_grad(loss)``) — the
+            # alias defeats name resolution, so mark bare-name-returned
+            # defs traced.  Only bare names (or tuples of them): a helper
+            # merely CALLED inside a return expression stays host-side.
+            for fi in list(mod.functions.values()):
+                for node in self._own_body_walk(fi):
+                    if not isinstance(node, ast.Return) or node.value is \
+                            None:
+                        continue
+                    vals = (node.value.elts
+                            if isinstance(node.value, ast.Tuple)
+                            else [node.value])
+                    for v in vals:
+                        if isinstance(v, ast.Name):
+                            cand = self.resolve_local(mod, fi, v.id)
+                            if cand is not None and cand.parent is fi:
+                                mark(cand)
+        while work:
+            fi = work.pop()
+            for callee in self._referenced_functions(fi):
+                mark(callee)
+
+    def _transform_calls(self, mod: ModuleInfo):
+        """(lexically enclosing FuncInfo, Call) for every tracing-transform
+        call in the module."""
+        out: list[tuple[FuncInfo | None, ast.Call]] = []
+
+        def walk(node: ast.AST, scope: FuncInfo | None) -> None:
+            for child in ast.iter_child_nodes(node):
+                s = scope
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    prefix = scope.qualname + "." if scope else ""
+                    s = mod.functions.get(prefix + child.name, scope)
+                elif isinstance(child, ast.Lambda):
+                    s = mod.lambda_infos.get(id(child), scope)
+                if isinstance(child, ast.Call) and \
+                        mod.transform_of(child.func):
+                    out.append((scope, child))
+                walk(child, s)
+
+        walk(mod.tree, None)
+        return out
+
+    def _funcs_named_in(self, mod: ModuleInfo, scope: FuncInfo | None,
+                        expr: ast.AST):
+        """FuncInfos referenced by bare name within ``expr`` (shallow)."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                fi = self.resolve_local(mod, scope, n.id)
+                if fi is not None:
+                    yield fi
+                else:
+                    yield from self.resolve_external(mod, n.id)
+
+    def _referenced_functions(self, fi: FuncInfo):
+        """Every function referenced from ``fi``'s own body (nested defs
+        excluded — they become reachable only if referenced)."""
+        mod = fi.module
+        for node in self._own_body_walk(fi):
+            if isinstance(node, ast.Lambda):
+                hit = mod.lambda_infos.get(id(node))
+                if hit is not None:
+                    yield hit
+            elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                           ast.Load):
+                hit = self.resolve_local(mod, fi, node.id)
+                if hit is not None and hit is not fi:
+                    yield hit
+                elif hit is None:
+                    yield from self.resolve_external(mod, node.id)
+
+    @staticmethod
+    def _own_body_walk(fi: FuncInfo):
+        """Walk ``fi``'s body without descending into nested function defs
+        or lambdas (each is its own FuncInfo, checked when reachable; the
+        Lambda/def node itself is still yielded so references resolve)."""
+        stack = list(getattr(fi.node, "body", [])) if not isinstance(
+            fi.node, ast.Lambda) else [fi.node.body]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef)):
+                    continue
+                if isinstance(child, ast.Lambda):
+                    yield child      # visible for reference resolution
+                    continue
+                stack.append(child)
+
+    # -- rule application -------------------------------------------------
+    def run(self) -> list[Violation]:
+        self.propagate()
+        for mod in self.modules.values():
+            self._check_module_wide(mod)
+            for fi in mod.functions.values():
+                if fi.reachable:
+                    self._check_traced_function(fi)
+        self.violations.sort(key=lambda v: (v.path, v.line, v.rule))
+        return self.violations
+
+    def _emit(self, mod: ModuleInfo, rule: str, node: ast.AST, func: str,
+              msg: str) -> None:
+        line = getattr(node, "lineno", 0)
+        if mod.suppressed(rule, line):
+            return
+        src = mod.lines[line - 1].strip() if 0 < line <= len(mod.lines) else ""
+        self.violations.append(Violation(
+            rule=rule, path=mod.relpath, line=line,
+            col=getattr(node, "col_offset", 0), func=func, msg=msg,
+            source=src))
+
+    # ---- module-wide rules: GL104, GL105, GL107 ----
+    def _check_module_wide(self, mod: ModuleInfo) -> None:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Call):
+                self._gl104_call(mod, node)
+                self._gl105_call(mod, node)
+                self._gl107_call(mod, node)
+            elif isinstance(node, ast.Attribute):
+                self._gl105_attr(mod, node)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                           ast.Load) \
+                    and node.id in mod.wide_dtype_names:
+                self._emit(mod, "GL105", node, "<module>",
+                           f"explicit 64-bit dtype {node.id!r} (imported "
+                           f"as numpy.{mod.wide_dtype_names[node.id]}) "
+                           f"defeats the x32 hot path")
+            elif isinstance(node, (ast.For, ast.comprehension)):
+                self._gl107_iter(mod, node)
+
+    def _gl104_call(self, mod: ModuleInfo, call: ast.Call) -> None:
+        """static_argnames/nums hazards on jit(...) / partial(jit, ...)."""
+        is_jit_call = mod.transform_of(call.func) == "jit"
+        is_partial_jit = (mod.is_partial(call.func) and call.args
+                          and mod.transform_of(call.args[0]) == "jit")
+        if not (is_jit_call or is_partial_jit):
+            return
+        names, nums = _literal_static_names(call)
+        if not names and not nums:
+            return
+        # find the decorated/wrapped function: decorator target, or the
+        # first positional function argument of jax.jit(f, ...)
+        target: FuncInfo | None = None
+        for fi in mod.functions.values():
+            for dec in fi.node.decorator_list if not isinstance(
+                    fi.node, ast.Lambda) else []:
+                if dec is call:
+                    target = fi
+        if target is None and is_jit_call and call.args:
+            t = call.args[0]
+            if isinstance(t, ast.Name):
+                target = self.resolve_local(mod, None, t.id)
+        if target is None or isinstance(target.node, ast.Lambda):
+            return
+        args = target.node.args
+        params = _param_names(args)
+        ann = {a.arg: a.annotation for a in
+               args.posonlyargs + args.args + args.kwonlyargs}
+        pos = [a.arg for a in args.posonlyargs + args.args]
+        defaults = dict(zip(pos[len(pos) - len(args.defaults):],
+                            args.defaults))
+        defaults.update({a.arg: d for a, d in
+                         zip(args.kwonlyargs, args.kw_defaults)
+                         if d is not None})
+        for name in sorted(names):
+            if name not in params:
+                self._emit(mod, "GL104", call, target.qualname,
+                           f"static_argnames names {name!r} which is not a "
+                           f"parameter of {target.qualname}() — jit will "
+                           f"raise at call time")
+            elif _annotation_is_array(ann.get(name)):
+                self._emit(mod, "GL104", call, target.qualname,
+                           f"static_argnames marks array-typed parameter "
+                           f"{name!r} static: every distinct VALUE "
+                           f"recompiles (and arrays are unhashable)")
+            elif name in defaults and isinstance(
+                    defaults[name], (ast.List, ast.Dict, ast.Set)):
+                self._emit(mod, "GL104", call, target.qualname,
+                           f"static parameter {name!r} has an unhashable "
+                           f"default — jit static args must be hashable")
+        n_params = len(params)
+        for num_node in nums:
+            for n in ast.walk(num_node):
+                if isinstance(n, ast.Constant) and isinstance(n.value, int):
+                    if n.value >= n_params or n.value < -n_params:
+                        self._emit(mod, "GL104", call, target.qualname,
+                                   f"static_argnums {n.value} out of range "
+                                   f"for {target.qualname}() with "
+                                   f"{n_params} parameters")
+
+    def _gl105_attr(self, mod: ModuleInfo, node: ast.Attribute) -> None:
+        if node.attr in ("float64", "complex128") and (
+                mod.is_numpy(node.value) or mod.is_jnp(node.value)):
+            self._emit(mod, "GL105", node, "<module>",
+                       f"explicit 64-bit dtype "
+                       f"`{_attr_root_name(node)}.{node.attr}` defeats the "
+                       f"x32 hot path (wrap in a justified "
+                       f"`# graftlint: disable=GL105` if host-only)")
+
+    def _gl105_call(self, mod: ModuleInfo, call: ast.Call) -> None:
+        for kw in call.keywords:
+            if kw.arg == "dtype" and isinstance(kw.value, ast.Constant) \
+                    and kw.value.value in ("float64", "complex128"):
+                self._emit(mod, "GL105", kw.value, "<module>",
+                           f"dtype={kw.value.value!r} string literal "
+                           f"defeats the x32 hot path")
+        if isinstance(call.func, ast.Attribute) and call.func.attr == \
+                "astype":
+            for a in call.args:
+                if isinstance(a, ast.Constant) and a.value in (
+                        "float64", "complex128"):
+                    self._emit(mod, "GL105", a, "<module>",
+                               f"astype({a.value!r}) promotes to 64-bit")
+
+    @staticmethod
+    def _is_set_expr(node: ast.AST) -> bool:
+        if isinstance(node, (ast.Set, ast.SetComp)):
+            return True
+        return (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Name)
+                and node.func.id in ("set", "frozenset"))
+
+    def _gl107_iter(self, mod: ModuleInfo, node) -> None:
+        it = node.iter
+        if self._is_set_expr(it):
+            self._emit(mod, "GL107", it, "<module>",
+                       "iteration order over a set is arbitrary — feed it "
+                       "through sorted() before it can reach a cache key "
+                       "or compiled-program structure")
+        elif (isinstance(it, ast.Call)
+              and isinstance(it.func, ast.Attribute)
+              and it.func.attr == "listdir"
+              and _attr_root_name(it.func) in mod.os_aliases):
+            self._emit(mod, "GL107", it, "<module>",
+                       "os.listdir() order is filesystem-dependent — "
+                       "sorted() it before hashing or staging")
+
+    def _gl107_call(self, mod: ModuleInfo, call: ast.Call) -> None:
+        # tuple(set(...)) / list(set(...)) / "".join(set(...)) keep the
+        # arbitrary order; sorted(set(...)) is the fix and is not flagged
+        if isinstance(call.func, ast.Name) and call.func.id in (
+                "tuple", "list"):
+            if call.args and self._is_set_expr(call.args[0]):
+                self._emit(mod, "GL107", call, "<module>",
+                           f"{call.func.id}(set(...)) preserves the "
+                           f"arbitrary set order — use sorted(...)")
+        if isinstance(call.func, ast.Attribute) and call.func.attr == \
+                "join" and call.args and self._is_set_expr(call.args[0]):
+            self._emit(mod, "GL107", call, "<module>",
+                       "join over a set is order-nondeterministic — "
+                       "use sorted(...)")
+
+    # ---- traced-function rules: GL101, GL102, GL103, GL106 ----
+    def _check_traced_function(self, fi: FuncInfo) -> None:
+        mod = fi.module
+        traced = self._traced_names(fi)
+        qual = fi.qualname
+        for node in self._own_body_walk(fi):
+            if isinstance(node, ast.Call):
+                self._traced_call_rules(mod, fi, node, traced, qual)
+            elif isinstance(node, (ast.If, ast.While, ast.Assert,
+                                   ast.IfExp)):
+                test = node.test
+                name = self._first_traced_mention(mod, test, traced)
+                if name is not None:
+                    kind = type(node).__name__.lower()
+                    self._emit(mod, "GL103", node, qual,
+                               f"Python `{kind}` on traced value {name!r} "
+                               f"inside jit-reachable {qual}() — branch "
+                               f"decisions must be jnp.where/lax.cond")
+            elif isinstance(node, ast.For):
+                name = self._first_traced_mention(mod, node.iter, traced)
+                if name is not None:
+                    self._emit(mod, "GL103", node, qual,
+                               f"Python `for` over traced value {name!r} "
+                               f"inside jit-reachable {qual}() — use "
+                               f"lax.scan/fori_loop")
+
+    def _traced_call_rules(self, mod, fi, node: ast.Call, traced, qual):
+        func = node.func
+        arg_name = None
+        for a in list(node.args) + [k.value for k in node.keywords]:
+            arg_name = self._first_traced_mention(mod, a, traced)
+            if arg_name is not None:
+                break
+        # GL106: host sync primitives
+        if isinstance(func, ast.Name) and func.id == "print":
+            self._emit(mod, "GL106", node, qual,
+                       f"print() inside jit-reachable {qual}() executes at "
+                       f"trace time only (or syncs) — use jax.debug.print")
+            return
+        if isinstance(func, ast.Attribute):
+            if func.attr in _HOST_SYNC_METHODS and arg_name is None:
+                base_name = self._first_traced_mention(mod, func.value,
+                                                      traced)
+                if base_name is not None:
+                    self._emit(mod, "GL106", node, qual,
+                               f".{func.attr}() on traced value "
+                               f"{base_name!r} inside {qual}() forces a "
+                               f"host<->device sync")
+                    return
+            if func.attr == "device_get" and self._jaxish(mod, func.value) \
+                    and arg_name is not None:
+                self._emit(mod, "GL106", node, qual,
+                           f"jax.device_get on traced value {arg_name!r} "
+                           f"inside {qual}() forces a host sync")
+                return
+            # numpy calls
+            root = _attr_root(func)
+            if mod.is_numpy(root):
+                if arg_name is None:
+                    return
+                if func.attr in ("asarray", "array", "copy"):
+                    self._emit(mod, "GL106", node, qual,
+                               f"np.{func.attr}() on traced value "
+                               f"{arg_name!r} inside {qual}() pulls the "
+                               f"array to host (TracerArrayConversionError "
+                               f"under jit)")
+                else:
+                    self._emit(mod, "GL101", node, qual,
+                               f"numpy call np.{func.attr}() receives "
+                               f"traced value {arg_name!r} inside "
+                               f"jit-reachable {qual}() — use the jnp "
+                               f"equivalent")
+                return
+        # GL102: python scalar casts
+        if isinstance(func, ast.Name) and func.id in ("float", "int",
+                                                      "bool", "complex"):
+            if arg_name is not None:
+                self._emit(mod, "GL102", node, qual,
+                           f"{func.id}() on traced value {arg_name!r} "
+                           f"inside jit-reachable {qual}() concretizes the "
+                           f"tracer (ConcretizationTypeError / host sync)")
+
+    def _jaxish(self, mod: ModuleInfo, node: ast.AST) -> bool:
+        return mod.is_jax(node) or mod.is_jnp(node)
+
+    # ---- taint --------------------------------------------------------
+    def _traced_names(self, fi: FuncInfo) -> set[str]:
+        """Parameters (minus statics) + lexically enclosing traced names +
+        names assigned from traced expressions, to a fixpoint."""
+        mod = fi.module
+        traced: set[str] = set()
+        scope = fi
+        while scope is not None:
+            if scope.reachable:
+                if isinstance(scope.node, ast.Lambda):
+                    traced |= set(_param_names(scope.node.args))
+                else:
+                    traced |= (set(scope.params) - scope.static_params)
+            scope = scope.parent
+        traced -= fi.static_params
+        traced -= self._literal_call_statics(fi)
+        if isinstance(fi.node, ast.Lambda):
+            return traced
+        for _ in range(3):  # small fixpoint: handles chained assignments
+            changed = False
+            for node in self._own_body_walk(fi):
+                targets: list[ast.AST] = []
+                value: ast.AST | None = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, (ast.For,)):
+                    targets, value = [node.target], node.iter
+                if value is None:
+                    continue
+                if self._first_traced_mention(mod, value, traced) is None:
+                    continue
+                for t in targets:
+                    for name in _target_names(t):
+                        if name not in traced:
+                            traced.add(name)
+                            changed = True
+            if not changed:
+                break
+        return traced
+
+    def _literal_call_statics(self, fi: FuncInfo) -> set[str]:
+        """For a nested def only ever CALLED directly by its parent (never
+        passed around), parameters that receive a literal constant at
+        every call site are static Python values, not tracers — e.g.
+        ``term(0, 0)`` selectors in an unrolled complex einsum."""
+        if fi.parent is None or isinstance(fi.node, ast.Lambda):
+            return set()
+        name = fi.node.name
+        calls: list[ast.Call] = []
+        for node in self._own_body_walk(fi.parent):
+            if isinstance(node, ast.Call) and isinstance(node.func,
+                                                         ast.Name) \
+                    and node.func.id == name:
+                calls.append(node)
+            elif isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                           ast.Load) \
+                    and node.id == name:
+                if not any(node is c.func for c in calls):
+                    return set()        # escapes as a value: keep traced
+        if not calls:
+            return set()
+        static: set[str] = set()
+        pos_params = [a.arg for a in fi.node.args.posonlyargs
+                      + fi.node.args.args]
+        for idx, pname in enumerate(pos_params):
+            vals = []
+            for c in calls:
+                if idx < len(c.args):
+                    vals.append(c.args[idx])
+                else:
+                    vals.extend(k.value for k in c.keywords
+                                if k.arg == pname)
+            if vals and all(isinstance(v, ast.Constant) for v in vals):
+                static.add(pname)
+        return static
+
+    def _first_traced_mention(self, mod: ModuleInfo, expr: ast.AST,
+                              traced: set[str]) -> str | None:
+        """First traced name mentioned in ``expr`` outside static-under-
+        trace contexts (shape/dtype/ndim reads, len()/isinstance(),
+        ``x is None`` checks)."""
+        if not traced:
+            return None
+        skip: set[int] = set()
+
+        def mark_skip(n: ast.AST) -> None:
+            for ch in ast.walk(n):
+                skip.add(id(ch))
+
+        for n in ast.walk(expr):
+            if id(n) in skip:
+                continue
+            if isinstance(n, ast.Attribute) and n.attr in _STATIC_ATTRS:
+                mark_skip(n)
+            elif isinstance(n, ast.Call):
+                fn = n.func
+                fname = None
+                if isinstance(fn, ast.Name):
+                    fname = fn.id
+                elif isinstance(fn, ast.Attribute):
+                    fname = fn.attr
+                if fname in _STATIC_CALLS:
+                    mark_skip(n)
+            elif isinstance(n, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot))
+                    for op in n.ops) and all(
+                    isinstance(c, ast.Constant) and c.value is None
+                    for c in n.comparators):
+                mark_skip(n)
+        for n in ast.walk(expr):
+            if id(n) in skip:
+                continue
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in traced:
+                return n.id
+        return None
+
+
+def _target_names(t: ast.AST):
+    """Names an assignment target stores into: ``br[j] = x`` stores into
+    ``br`` (the index ``j`` is only read, so it must not be tainted)."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _target_names(e)
+    elif isinstance(t, ast.Starred):
+        yield from _target_names(t.value)
+    elif isinstance(t, (ast.Subscript, ast.Attribute)):
+        yield from _target_names(t.value)
+
+
+def _dotted_name(relpath: str) -> str:
+    name = relpath[:-3] if relpath.endswith(".py") else relpath
+    name = name.replace(os.sep, ".")
+    if name.endswith(".__init__"):
+        name = name[: -len(".__init__")]
+    return name
+
+
+def collect_py_files(paths: list[str], root: str) -> list[str]:
+    """Expand lint targets to .py files.  A target that does not exist
+    raises — a gate that silently lints nothing because of a typo'd path
+    would report green forever."""
+    out: list[str] = []
+    for p in paths:
+        ap = p if os.path.isabs(p) else os.path.join(root, p)
+        if not os.path.exists(ap):
+            raise FileNotFoundError(
+                f"lint target {p!r} does not exist under {root!r}")
+        if os.path.isdir(ap):
+            for dirpath, dirnames, filenames in sorted(os.walk(ap)):
+                dirnames[:] = sorted(d for d in dirnames
+                                     if d not in ("__pycache__",))
+                for fn in sorted(filenames):
+                    if fn.endswith(".py"):
+                        out.append(os.path.join(dirpath, fn))
+        elif ap.endswith(".py"):
+            out.append(ap)
+        else:
+            raise ValueError(f"lint target {p!r} is neither a directory "
+                             f"nor a .py file")
+    return out
+
+
+def lint_paths(paths: list[str], root: str) -> list[Violation]:
+    """Run every rule over the .py files under ``paths`` (dirs recurse)."""
+    files = collect_py_files(paths, root)
+    return Analyzer(files, root).run()

@@ -724,6 +724,39 @@ def load_design(fname: str) -> dict:
         return yaml.safe_load(f)
 
 
+def stage_design_base(fname: str, nw: int, Hs: float, Tp: float,
+                      w_min: float, w_max: float,
+                      with_mooring: bool = True):
+    """One-call staging of a design to the forward-pipeline inputs:
+    ``(design, members, rna, env, wave, C_moor)``.
+
+    The shared recipe behind the driver entry (``__graft_entry__._base6``)
+    and the trace-audit registry (``raft_tpu.lint.registry``) — one
+    staging contract, so the audit's "mirror of the traced core" cannot
+    drift from the program the driver actually compiles.
+
+    ``with_mooring=False`` skips the mooring parse + linearized-stiffness
+    solve (``C_moor`` is then None): the stiffness is a jitted
+    forward-mode Jacobian through the catenary Newton solve, so call
+    sites that bring their own mooring must not pay its compile.
+    """
+    design = load_design(fname)
+    members = build_member_set(design)
+    rna = build_rna(design)
+    depth = float(design["mooring"]["water_depth"])
+    env = Env(Hs=Hs, Tp=Tp, depth=depth)
+    w = jnp.asarray(np.linspace(w_min, w_max, nw))
+    wave = WaveState(w=w, k=wave_number(w, depth),
+                     zeta=jnp.sqrt(jonswap(w, Hs, Tp)))
+    C_moor = None
+    if with_mooring:
+        moor = parse_mooring(
+            design["mooring"],
+            yaw_stiffness=design["turbine"]["yaw_stiffness"])
+        C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    return design, members, rna, env, wave, C_moor
+
+
 def run_raft(fname_design: str, fname_env: str | None = None,
              plot: bool = False, w=None) -> dict:
     """End-to-end analysis recipe (cf. runRAFT, raft/runRAFT.py:23-82).

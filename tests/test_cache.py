@@ -177,6 +177,46 @@ def test_callable_salt_sees_closure_values():
         make_arr(np.zeros(3)))
 
 
+def test_callable_salt_stable_for_hooks_containing_lambdas():
+    """The salt must be process-stable for hooks whose code objects nest
+    lambdas/comprehensions: code-object repr embeds a memory address, so
+    hashing repr(co_consts) would give every process a different salt and
+    silently defeat the cross-process AOT disk layer.  Re-exec'ing the
+    same source twice (fresh code objects at fresh addresses, no
+    retrievable source — exec-defined) simulates two processes."""
+    src = ("def hook(x):\n"
+           "    return sum(y * 2 for y in x) + (lambda z: z + 1)(0)\n")
+
+    def build():
+        ns: dict = {}
+        exec(src, ns)          # noqa: S102 - test-local source
+        return ns["hook"]
+
+    assert aot.callable_salt(build()) == aot.callable_salt(build())
+
+
+def test_callable_salt_stable_across_hash_seeds():
+    """frozenset constants (compiled from `x in {...}` membership tests)
+    iterate in PYTHONHASHSEED order — the salt must canonicalize them or
+    every process computes a different key and the AOT disk layer never
+    hits.  Two subprocesses with different seeds must agree."""
+    code = ("import sys; sys.path.insert(0, %r)\n"
+            "from raft_tpu.cache import aot\n"
+            "def hook(x):\n"
+            "    return x if x in {'alpha', 'beta', 'gamma'} else 0\n"
+            "print(aot.callable_salt(hook)[1])\n") % REPO
+
+    def salt_under(seed):
+        r = subprocess.run(
+            [sys.executable, "-c", code], capture_output=True, text=True,
+            timeout=60, env={**os.environ, "PYTHONHASHSEED": seed},
+        )
+        assert r.returncode == 0, r.stderr[-800:]
+        return r.stdout.strip()
+
+    assert salt_under("1") == salt_under("2")
+
+
 def test_bench_stderr_tail_redaction():
     import importlib.util
 

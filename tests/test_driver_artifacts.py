@@ -136,3 +136,64 @@ def test_spawn_full_bench_guards(tmp_path, monkeypatch):
     assert out is None and "stderr_tail" in err
     assert "SEKRET" not in err["stderr_tail"]
     assert "died" in err["stderr_tail"]
+
+
+def test_dryrun_cpu_device_plan_selection():
+    """Non-slow pin on the jax-0.4.37 dryrun fix: the mesh-mechanism
+    fallback must select correctly in every regime (first-class
+    jax_num_cpu_devices knob vs XLA_FLAGS vs subprocess re-exec)."""
+    import __graft_entry__ as g
+
+    # enough devices however they arrived: proceed
+    assert g._cpu_device_plan(True, 8, 8, False) == "ok"
+    assert g._cpu_device_plan(False, 8, 8, False) == "ok"
+    assert g._cpu_device_plan(False, 16, 8, True) == "ok"
+    # knob took effect yet devices are short: a real failure, re-exec
+    # would change nothing
+    assert g._cpu_device_plan(True, 1, 8, False) == "fail"
+    # old jax, flags already parsed without ours: re-exec with env preset
+    assert g._cpu_device_plan(False, 1, 8, False) == "reexec"
+    # ... but never recurse: the guard makes a second shortfall terminal
+    assert g._cpu_device_plan(False, 1, 8, True) == "fail"
+
+
+def test_dryrun_host_device_flag_is_replaced_not_kept():
+    """An inherited smaller device count must be REWRITTEN to the
+    requested one — keeping it would make the re-exec child fail the very
+    shortfall it exists to fix."""
+    import __graft_entry__ as g
+
+    f = g._with_host_device_flag
+    assert f("", 8) == "--xla_force_host_platform_device_count=8"
+    assert f("--xla_force_host_platform_device_count=8", 16) == \
+        "--xla_force_host_platform_device_count=16"
+    out = f("--foo=1 --xla_force_host_platform_device_count=8 --bar=2", 16)
+    assert "--xla_force_host_platform_device_count=16" in out
+    assert "count=8" not in out and "--foo=1" in out and "--bar=2" in out
+    assert f("--foo=1", 4) == "--foo=1 --xla_force_host_platform_device_count=4"
+
+
+def test_dryrun_num_cpu_devices_knob_probe():
+    """_config_cpu_devices must never raise — on jax without the knob
+    (0.4.37: AttributeError 'Unrecognized config option') it reports
+    False and the XLA_FLAGS path carries the mesh."""
+    import jax
+
+    import __graft_entry__ as g
+
+    class _RaisingConfig:
+        def update(self, *a):
+            raise AttributeError("Unrecognized config option: "
+                                 "jax_num_cpu_devices")
+
+    class _FakeJax:
+        config = _RaisingConfig()
+
+    assert g._config_cpu_devices(_FakeJax(), 8) is False
+
+    # against the REAL jax: never raises, reports a bool (False on this
+    # container's 0.4.37; True once the knob exists and takes)
+    ok = g._config_cpu_devices(jax, len(jax.devices()))
+    assert isinstance(ok, bool)
+    if not hasattr(jax.config, "jax_num_cpu_devices"):
+        assert ok is False

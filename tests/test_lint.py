@@ -1,0 +1,446 @@
+"""graftlint: fixture corpus (one trigger + one near-miss per rule),
+suppression + baseline machinery, reachability edge cases, the trace
+audit's budget pins for the north-star sweep entry, and the repo gate
+(the merged tree must stay clean vs the committed baseline — running in
+the fast tier makes any lint regression fail ``make fast``)."""
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import pytest
+
+from raft_tpu.lint import baseline as bl
+from raft_tpu.lint.rules import RULES, lint_paths
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _lint_src(tmp_path, src, name="mod.py", extra=None):
+    p = tmp_path / name
+    p.write_text(textwrap.dedent(src))
+    if extra:
+        for fname, fsrc in extra.items():
+            (tmp_path / fname).write_text(textwrap.dedent(fsrc))
+    return lint_paths([str(tmp_path)], str(tmp_path))
+
+
+# --------------------------------------------------------------------------
+# fixture corpus: (rule, trigger source, near-miss source)
+# --------------------------------------------------------------------------
+FIXTURES = {
+    "GL101": (
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.sin(x)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            c = np.sin(0.5)          # host constant: no tracer involved
+            return jnp.sin(x) * c
+        """,
+    ),
+    "GL102": (
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            return float(x) + 1.0
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            n = float(x.shape[0])    # shape is static under trace
+            return x * n
+        """,
+    ),
+    "GL103": (
+        """
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                return x
+            return -x
+        """,
+        """
+        import jax
+
+        @jax.jit
+        def f(x, flag=None):
+            if flag is None:         # pytree-structure check: static
+                return x
+            if x.shape[0] == 3:      # shape: static
+                return x + x
+            return x
+        """,
+    ),
+    "GL104": (
+        """
+        from functools import partial
+        import jax
+        import jax.numpy as jnp
+
+        Array = jnp.ndarray
+
+        @partial(jax.jit, static_argnames=("scale", "typo"))
+        def f(x, scale: Array):
+            return x * scale
+        """,
+        """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("n",))
+        def f(x, n: int = 3):
+            return x * n
+        """,
+    ),
+    "GL105": (
+        """
+        import numpy as np
+
+        BAD = np.zeros(3, dtype=np.float64)
+
+        def g(arr):
+            return arr.astype("float64")
+        """,
+        """
+        import numpy as np
+
+        OK = np.zeros(3, dtype=np.float32)
+        # justified host-side use rides a suppression:
+        HASHED = np.float64(1.5)  # graftlint: disable=GL105
+        """,
+    ),
+    "GL106": (
+        """
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            print(x)
+            return np.asarray(x)
+        """,
+        """
+        import numpy as np
+
+        def host_report(x):          # never jit-reachable: host is free
+            print(x)
+            return np.asarray(x)
+        """,
+    ),
+    "GL107": (
+        """
+        def key_parts(names):
+            out = []
+            for k in {"b", "a"}:
+                out.append(k)
+            return tuple(set(out))
+        """,
+        """
+        def key_parts(names):
+            out = []
+            for k in sorted({"b", "a"}):
+                out.append(k)
+            return tuple(sorted(set(out)))
+        """,
+    ),
+}
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_triggers(tmp_path, rule):
+    trigger, _ = FIXTURES[rule]
+    vs = _lint_src(tmp_path, trigger)
+    hits = [v for v in vs if v.rule == rule]
+    assert hits, f"{rule} fixture produced no {rule} violation: {vs}"
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_rule_near_miss(tmp_path, rule):
+    _, near_miss = FIXTURES[rule]
+    vs = _lint_src(tmp_path, near_miss)
+    hits = [v for v in vs if v.rule == rule]
+    assert not hits, f"{rule} near-miss wrongly flagged: " + "\n".join(
+        v.format() for v in hits)
+
+
+@pytest.mark.parametrize("rule", sorted(FIXTURES))
+def test_cli_fails_on_each_seeded_fixture(tmp_path, rule):
+    """`python -m raft_tpu.lint <fixture>` (in-process main) must exit
+    non-zero on every seeded-violation fixture — the acceptance gate."""
+    from raft_tpu.lint.cli import main
+
+    p = tmp_path / "bad.py"
+    p.write_text(textwrap.dedent(FIXTURES[rule][0]))
+    rc = main([str(p), "--root", str(tmp_path), "--no-baseline"])
+    assert rc == 1
+
+
+# --------------------------------------------------------------------------
+# reachability edges
+# --------------------------------------------------------------------------
+def test_nested_def_passed_to_vmap_is_reachable(tmp_path):
+    vs = _lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        def orchestrator(members, thetas):
+            def one(theta):
+                return np.abs(theta)
+            return jax.jit(jax.vmap(one))(thetas)
+        """)
+    assert any(v.rule == "GL101" and ".one" in v.msg for v in vs), vs
+
+
+def test_returned_closure_is_reachable(tmp_path):
+    vs = _lint_src(tmp_path, """
+        import numpy as np
+
+        def make_loss(members):
+            def loss(theta):
+                return np.abs(theta)
+            return loss
+        """)
+    assert any(v.rule == "GL101" for v in vs), vs
+
+
+def test_cross_module_call_edge(tmp_path):
+    vs = _lint_src(tmp_path, """
+        import jax
+        from helper import warp
+
+        @jax.jit
+        def f(x):
+            return warp(x)
+        """, extra={"helper.py": """
+        import numpy as np
+
+        def warp(x):
+            return np.tanh(x)
+        """})
+    assert any(v.rule == "GL101" and v.path == "helper.py" for v in vs), vs
+
+
+def test_host_orchestrator_not_reachable(tmp_path):
+    """A host function calling jitted code freely uses numpy/print."""
+    vs = _lint_src(tmp_path, """
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def solve(x):
+            return jnp.sin(x)
+
+        def orchestrator(x):
+            out = solve(jnp.asarray(x))
+            print("done")
+            return np.asarray(out)
+        """)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_jax_tree_map_is_not_a_tracing_transform(tmp_path):
+    vs = _lint_src(tmp_path, """
+        import jax
+        import numpy as np
+
+        def stage(tree):
+            def put(x):
+                return np.asarray(x)
+            return jax.tree.map(put, tree)
+        """)
+    assert vs == [], [v.format() for v in vs]
+
+
+def test_static_argname_params_are_not_traced(tmp_path):
+    vs = _lint_src(tmp_path, """
+        from functools import partial
+        import jax
+
+        @partial(jax.jit, static_argnames=("method",))
+        def f(x, method):
+            if method == "scan":
+                return x + 1
+            return x
+        """)
+    assert vs == [], [v.format() for v in vs]
+
+
+# --------------------------------------------------------------------------
+# suppression + baseline machinery
+# --------------------------------------------------------------------------
+def test_gl105_catches_from_import_spelling(tmp_path):
+    vs = _lint_src(tmp_path, """
+        from numpy import float64 as f64
+
+        BAD = f64(1.5)
+        """)
+    assert any(v.rule == "GL105" for v in vs), vs
+
+
+def test_line_suppression(tmp_path):
+    vs = _lint_src(tmp_path, """
+        import numpy as np
+
+        A = np.zeros(2, dtype=np.float64)  # graftlint: disable=GL105
+        """)
+    assert vs == []
+
+
+def test_file_suppression(tmp_path):
+    vs = _lint_src(tmp_path, """
+        # graftlint: disable-file=GL105 — host ABI requires doubles
+        import numpy as np
+
+        A = np.zeros(2, dtype=np.float64)
+        B = np.ones(2, dtype=np.float64)
+        """)
+    assert vs == []
+
+
+def test_baseline_round_trip(tmp_path):
+    src = """
+        import numpy as np
+
+        A = np.zeros(2, dtype=np.float64)
+        """
+    vs = _lint_src(tmp_path, src)
+    assert len(vs) == 1
+    path = str(tmp_path / "baseline.json")
+    bl.save(vs, path)
+    fresh, absorbed = bl.filter_new(vs, path)
+    assert fresh == [] and absorbed == 1
+    # a NEW violation in the same file is not absorbed
+    vs2 = _lint_src(tmp_path, src + "B = np.ones(3, dtype=np.float64)\n")
+    fresh2, absorbed2 = bl.filter_new(vs2, path)
+    assert absorbed2 == 1 and len(fresh2) == 1
+    # fingerprints are line-number-free: prepending a comment moves every
+    # line yet the baseline still absorbs the violation
+    vs3 = _lint_src(tmp_path,
+                    "# a new leading comment\n" + textwrap.dedent(src))
+    fresh3, _ = bl.filter_new(vs3, path)
+    assert fresh3 == []
+
+
+# --------------------------------------------------------------------------
+# repo gate: the merged tree stays clean (fails `make fast` on regression)
+# --------------------------------------------------------------------------
+def test_repo_is_lint_clean_vs_baseline():
+    vs = lint_paths(["raft_tpu", "__graft_entry__.py", "bench.py"], REPO)
+    fresh, _ = bl.filter_new(vs)
+    assert fresh == [], "NEW lint violations:\n" + "\n".join(
+        v.format() for v in fresh)
+
+
+def test_cli_fails_loud_on_typod_target(tmp_path):
+    """A misspelled lint target must never report green over zero files."""
+    from raft_tpu.lint.cli import main
+
+    rc = main([str(tmp_path / "sovle"), "--root", str(tmp_path),
+               "--no-baseline"])
+    assert rc == 2
+
+
+def test_cli_subprocess_green_on_repo():
+    r = subprocess.run([sys.executable, "-m", "raft_tpu.lint", "--json"],
+                       cwd=REPO, capture_output=True, text=True,
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["ok"] and summary["static"]["new"] == 0
+
+
+# --------------------------------------------------------------------------
+# trace audit
+# --------------------------------------------------------------------------
+def test_audit_north_star_sweep_budgets():
+    """Acceptance pin: repeated same-shape north-star sweep call does not
+    retrace, and its jaxpr has zero f64 leaves under x32 and zero host
+    callbacks."""
+    from raft_tpu.lint.audit import audit_entry
+    from raft_tpu.lint.registry import get_entries
+
+    (entry,) = get_entries(["north_star_sweep"])
+    r = audit_entry(entry)
+    assert r.retraces == 0, r.to_dict()
+    assert r.f64_leaves == 0, r.to_dict()
+    assert r.host_callbacks == 0, r.to_dict()
+    assert r.ok and r.n_eqns > 100
+
+
+def test_audit_registry_covers_required_entries():
+    from raft_tpu.lint.registry import ENTRY_POINTS
+
+    names = {e.name for e in ENTRY_POINTS}
+    assert {"north_star_sweep", "dlc_solve", "freq_sharded_forward",
+            "val_grad", "eigen"} <= names
+
+
+def test_audit_jaxpr_detects_f64_leaves():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.lint.audit import audit_jaxpr
+
+    # the suite runs x64, so a float64 pipeline is easy to make; the
+    # walker must count its wide avals
+    jaxpr = jax.make_jaxpr(lambda x: jnp.sin(x) * 2.0)(
+        jnp.ones(3, dtype=jnp.float64))
+    n_eqns, wide, examples, callbacks = audit_jaxpr(jaxpr)
+    assert wide > 0 and examples
+
+
+def test_audit_jaxpr_detects_host_callbacks():
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.lint.audit import audit_jaxpr
+
+    def f(x):
+        jax.debug.print("x={x}", x=x)
+        return x * 2
+
+    jaxpr = jax.make_jaxpr(f)(jnp.ones(3))
+    _, _, _, callbacks = audit_jaxpr(jaxpr)
+    assert callbacks >= 1
+
+
+def test_retrace_counter_detects_signature_instability():
+    import jax.numpy as jnp
+
+    from raft_tpu.lint.audit import _count_retraces
+
+    fn = lambda x: x + 1  # noqa: E731
+    # same shape, different dtype: a second abstract signature must be
+    # reported as a retrace
+    n = _count_retraces(fn, (jnp.ones(3, dtype=jnp.float32),),
+                        (jnp.ones(3, dtype=jnp.int32),))
+    assert n == 1
+    n0 = _count_retraces(fn, (jnp.ones(3, dtype=jnp.float32),),
+                         (2.0 * jnp.ones(3, dtype=jnp.float32),))
+    assert n0 == 0
+
+
+def test_rules_catalog_documented():
+    """Every rule ID has a docs section (docs/lint.rst ships the catalog)."""
+    docs = open(os.path.join(REPO, "docs", "lint.rst")).read()
+    for rule in RULES:
+        assert rule in docs, f"{rule} missing from docs/lint.rst"

@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke lint
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke lint
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -12,6 +12,9 @@ lint:            ## graftlint: static rules vs baseline + trace audit
 
 cache-smoke:     ## warm-start proof: tiny sweep twice in fresh processes,
 	python -m raft_tpu.cache smoke   # 2nd run's compile must be < 50% of 1st
+
+pipeline-smoke:  ## fused-kernel + dispatch-ahead + donation proof (CPU, < 60 s)
+	python -c "from raft_tpu.parallel.pipeline import _smoke; raise SystemExit(_smoke())"
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

@@ -81,6 +81,34 @@ def _probe_backend(timeout=_PROBE_TIMEOUT_S, retries=_PROBE_RETRIES,
     return None, err
 
 
+def _pick_chunk(batch: int, requested: int) -> int:
+    """Largest divisor of ``batch`` that is <= ``requested``.
+
+    Proper divisor scan (sqrt enumeration), not a decrement loop: the
+    answer is the same, but the scan makes the degenerate case explicit —
+    a prime-ish ``batch`` has no divisor near the request, and silently
+    running ``chunk=1`` would serialize the whole sweep into per-design
+    dispatches.  When the best divisor is below half the request a
+    warning names the problem (pick a batch with friendlier factors).
+    """
+    requested = max(1, min(int(requested), int(batch)))
+    best = 1
+    for d in range(1, int(batch ** 0.5) + 1):
+        if batch % d == 0:
+            for c in (d, batch // d):
+                if c <= requested and c > best:
+                    best = c
+    if best < max(1, requested // 2):
+        import warnings
+
+        warnings.warn(
+            f"batch={batch} has no divisor in [{max(1, requested // 2)}, "
+            f"{requested}]: chunking degenerates to chunk={best} "
+            f"(worst case 1 for a prime batch). Choose a batch size with "
+            f"a divisor near the requested chunk.", stacklevel=2)
+    return best
+
+
 def _flops_per_call(compiled):
     """XLA's own FLOP estimate for a compiled executable (None if the
     backend doesn't expose cost analysis)."""
@@ -182,19 +210,22 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
     asserted.  The batch runs in ``chunk``-sized sub-batches (one
     compilation, reused) so per-step HBM stays bounded: the dominant live
     tensors are the per-lane node wave kinematics, ~6 MB x chunk for this
-    hull/grid.
+    hull/grid.  Chunks execute through the dispatch-ahead pipeline
+    (``raft_tpu.parallel.pipeline``, depth ``RAFT_TPU_PIPELINE_DEPTH``):
+    staging chunk k+1 and fetching chunk k-depth's results overlap the
+    device compute of the in-flight chunks, and only per-lane response
+    statistics (std dev reduced on device, the sweep's ``return_xi=False``
+    semantics) cross back to host.
     """
     import jax
     import jax.numpy as jnp
 
     from raft_tpu.parallel import (
-        forward_response, make_scale_plan, make_stretch_draft,
+        forward_response, make_scale_plan, make_stretch_draft, response_std,
     )
 
     design, members, rna, env, wave, C_moor, bem = setup or _volturn_setup(nw=nw)
-    chunk = min(chunk, batch)
-    while batch % chunk != 0:      # largest divisor of batch <= requested
-        chunk -= 1
+    chunk = _pick_chunk(batch, chunk)
     draft = make_stretch_draft(members)
     plan = make_scale_plan(members)
 
@@ -205,7 +236,11 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
         out = forward_response(
             m, rna, env, wave, C_moor, bem=bem, n_iter=40, method="while",
         )
-        return out.Xi.abs2(), out.converged, out.n_iter
+        # response std dev reduced ON DEVICE (sweep's return_xi=False
+        # mode): the (chunk, nw, 6) spectra never cross to host — the
+        # fetch is (chunk, 6) statistics plus the convergence flags
+        return (response_std(out.Xi.abs2(), wave.w), out.converged,
+                out.n_iter)
 
     # near-square grid over (plan radius, draft) covering +-10%
     n_d = int(np.sqrt(batch))
@@ -217,11 +252,9 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
         return np.linspace(0.9, 1.1, n) if n > 1 else np.array([1.0])
 
     dd, pp = np.meshgrid(axis(n_d), axis(n_p))
-    scales = jnp.asarray(
-        np.stack([pp.ravel(), dd.ravel()], axis=1).reshape(
-            batch // chunk, chunk, 2
-        )
-    )
+    scales = np.stack([pp.ravel(), dd.ravel()], axis=1).reshape(
+        batch // chunk, chunk, 2
+    )  # HOST chunk table: each chunk is staged fresh per dispatch
 
     from raft_tpu.utils import profiling as prof
 
@@ -230,11 +263,18 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
     # The compile goes through the warm-start registry: a repeat process
     # deserializes the stored executable (or at worst re-traces into the
     # persistent XLA cache) instead of paying the full compile.
+    # No donate_argnums here: the only argument is the (chunk, 2) theta
+    # table, and donation needs an output of identical shape/dtype to
+    # alias — the north star's large tensors are closure consts (staged
+    # BEM) or XLA-managed internals.  The donating path is the DLC
+    # sweep's per-chunk staged excitation (sweep_sea_states(chunk=...)).
     from raft_tpu import cache
+    from raft_tpu.parallel import pipeline as pipe
 
+    args0 = (jnp.asarray(scales[0]),)
     with prof.phase("north_star/compile"):
         compiled = cache.cached_compile(
-            "bench.north_star", jax.vmap(one), (scales[0],),
+            "bench.north_star", jax.vmap(one), args0,
             consts=(members, rna, env, wave, C_moor, bem),
             # bench.py sits OUTSIDE the package code_fingerprint walk, so
             # the traced closure must salt the key itself: an edit to
@@ -243,26 +283,33 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
                    *cache.callable_salt(one)),
         )
     flops_chunk = _flops_per_call(compiled)
+    depth = pipe.dispatch_depth()
 
     def run_all():
-        outs = [compiled(c) for c in scales]      # sequential chunks
-        outs[-1][0].block_until_ready()
-        return outs
+        """Dispatch-ahead chunk pipeline: chunk k+1 staged (host->device)
+        and dispatched before chunk k-depth's results are fetched."""
+        return pipe.run_pipelined(
+            compiled, scales, depth=depth,
+            stage=lambda c: (jax.device_put(jnp.asarray(c)),),
+        )
 
     with prof.phase("north_star/warmup_validate"):
-        outs = run_all()                          # warm + validate
+        outs, _ = run_all()                       # warm + validate
         conv = np.concatenate([np.asarray(c) for _, c, _ in outs])
         n_conv = int(conv.sum())
         assert n_conv == batch, f"only {n_conv}/{batch} design lanes converged"
-        for a, _, _ in outs:
-            assert np.isfinite(np.asarray(a)).all(), "non-finite response"
+        for s, _, _ in outs:
+            assert np.isfinite(np.asarray(s)).all(), "non-finite response"
         iters = max(int(np.asarray(i).max()) for _, _, i in outs)
     best = np.inf
+    pipe_stats = None
     with prof.phase("north_star/run"):
         for _ in range(reps):
             t0 = time.perf_counter()
-            run_all()
-            best = min(best, time.perf_counter() - t0)
+            _, stats = run_all()
+            dt = time.perf_counter() - t0
+            if dt < best:
+                best, pipe_stats = dt, stats
     from raft_tpu.core import pallas6
 
     out = {
@@ -278,6 +325,13 @@ def north_star(batch: int = 1000, nw: int = 200, reps: int = 3, setup=None,
         # which solve path this artifact measured (the kernel is auto-on
         # on TPU since round 5) — cross-round comparisons need this
         "pallas_active": pallas6.enabled(),
+        # provenance of this PR's hot-path changes: the fused
+        # assemble+solve (never materializing Z in HBM) and the
+        # dispatch-ahead chunk pipeline with device-side std-dev
+        # reduction (return_xi=False semantics)
+        "fused_solve": True,
+        "return_xi": False,
+        "pipeline": pipe_stats.to_dict() if pipe_stats is not None else None,
     }
     if flops_chunk is not None:
         # achieved FLOP/s over the whole batch: XLA's static per-chunk

@@ -198,6 +198,27 @@ def _solver_salts() -> tuple:
             "xla_flags", os.environ.get("XLA_FLAGS", ""))
 
 
+def donation_salt(jit_kwargs: dict | None) -> tuple:
+    """Key component for the buffer-donation signature of a jit call.
+
+    Donation is baked into the compiled executable (donated parameters
+    alias their output buffers), so an executable compiled with
+    ``donate_argnums=(0,)`` must NEVER be served to a call site compiled
+    without it (and vice versa): the donating executable invalidates
+    input buffers the non-donating caller still holds live.  Folded into
+    every :func:`cached_compile` key alongside the solver salts.
+    """
+    kw = jit_kwargs or {}
+
+    def norm(v):
+        if v is None:
+            return ()
+        return tuple(v) if isinstance(v, (tuple, list)) else (v,)
+
+    return ("donate", norm(kw.get("donate_argnums")),
+            norm(kw.get("donate_argnames")))
+
+
 def aot_key(tag: str, args, consts=(), mesh=None, extra=()) -> str:
     """Hex digest naming one executable in the registry."""
     h = hashlib.sha256()
@@ -277,7 +298,11 @@ def cached_compile(tag: str, fn, args, *, consts=(), mesh=None,
     ``consts`` MUST cover every array/scalar the traced ``fn`` closes over
     (it is part of the key — see module docstring); ``extra`` folds in any
     additional statics (e.g. hyperparameters already baked into the trace
-    but not arrays, or :func:`callable_salt` of user hooks).
+    but not arrays, or :func:`callable_salt` of user hooks).  The
+    donation signature in ``jit_kwargs`` (``donate_argnums`` /
+    ``donate_argnames``) is folded into the key automatically
+    (:func:`donation_salt`) — flipping the donation flag can never be
+    served a stale executable compiled under the other aliasing contract.
     """
     import jax
 
@@ -286,7 +311,8 @@ def cached_compile(tag: str, fn, args, *, consts=(), mesh=None,
         return jax.jit(fn, **kw).lower(*args).compile()
     from raft_tpu.utils import profiling as prof
 
-    key = aot_key(tag, args, consts=consts, mesh=mesh, extra=extra)
+    key = aot_key(tag, args, consts=consts, mesh=mesh,
+                  extra=(*tuple(extra), donation_salt(kw)))
     hit = _mem.get(key)
     if hit is not None:
         stats.record("aot", "mem_hit")

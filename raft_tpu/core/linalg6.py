@@ -13,6 +13,14 @@ Everything here is batch-broadcast over leading axes and differentiable.
 Kernels:
   * :func:`solve_cx`   — complex 6x6 solve (Gaussian elimination, partial
                          pivoting) on :class:`~raft_tpu.core.cplx.Cx` pairs.
+  * :func:`solve_cx_fused` — the same solve with the RAO impedance
+                         assembly ``Z = Z0 + i w B_drag`` fused into the
+                         solve expression (XLA fuses the elementwise
+                         assembly into the elimination's first consumer,
+                         so the complex ``Z`` is never a standalone HBM
+                         tensor) — the CPU/interpret twin of the Pallas
+                         fused kernel (:func:`raft_tpu.core.pallas6.
+                         solve_rao_pallas`).
   * :func:`solve_re`   — same for real systems.
   * :func:`eigh_jacobi`— symmetric eigendecomposition by fixed-sweep cyclic
                          Jacobi rotations (replaces np.linalg.eig of the
@@ -105,6 +113,32 @@ def solve_cx(A: Cx, b: Cx, n: int = 6) -> Cx:
     if vec:
         x = Cx(x.re[..., 0], x.im[..., 0])
     return x
+
+
+def assemble_impedance(Z0: Cx, w: Array, B_drag: Array) -> Cx:
+    """``Z = Z0 + i w B_drag``: fold the per-iteration drag damping into a
+    precomputed loop-invariant impedance ``Z0 = -w^2 M + i w B + C``.
+
+    ``Z0``: (..., nw, 6, 6) Cx; ``w``: broadcastable to (..., nw);
+    ``B_drag``: (..., 6, 6) real — one drag matrix per design, broadcast
+    over the frequency axis.  Only the imaginary part changes, so the
+    real part is passed through untouched (exactly bit-preserving).
+    """
+    return Cx(Z0.re, Z0.im + w[..., None, None] * B_drag[..., None, :, :])
+
+
+def solve_cx_fused(Z0: Cx, w: Array, B_drag: Array, F: Cx, n: int = 6) -> Cx:
+    """Fused RAO assemble+solve: ``x = (Z0 + i w B_drag)^-1 F``.
+
+    The XLA fallback of the Pallas fused kernel
+    (:func:`raft_tpu.core.pallas6.solve_rao_pallas`): the assembly is an
+    elementwise expression feeding straight into :func:`solve_cx`, so XLA
+    fuses it into the elimination and the assembled complex ``Z`` never
+    round-trips through HBM inside the fixed point.  Fully transformable
+    (vmap/jvp/grad/shard_map) — this is also the path the ``custom_vjp``
+    adjoint falls back to for bit-comparability checks.
+    """
+    return solve_cx(assemble_impedance(Z0, w, B_drag), F, n=n)
 
 
 def solve_re(A: Array, b: Array, n: int = 6) -> Array:

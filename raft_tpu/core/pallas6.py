@@ -24,6 +24,18 @@ Status — the decided position, taken from hardware measurements:
   that dominates the whole solve, which the kernel's lane-wise blends
   avoid entirely.  The kernel is additionally bit-validated against
   ``linalg6.solve_cx`` in interpreter mode (``tests/test_pallas6.py``).
+* **Fused assemble+solve for the fixed point.** The RAO fixed point's
+  per-iteration work is ``solve(Z0 + i w B_drag, F)`` with only the
+  small real drag update changing between iterations; the plain kernel
+  forces the caller to materialize the full (..., nw, 6, 6) complex
+  impedance in HBM every iteration just to hand it over.
+  :func:`solve_rao_pallas` moves the assembly INSIDE the VMEM-resident
+  block: per iteration the kernel reads the loop-invariant ``Z0`` pair
+  plus the per-lane ``w`` and broadcast ``B_drag`` (half the dynamic
+  HBM traffic of write+read of the assembled ``Z``) and the assembled
+  impedance never exists outside VMEM.  Both fixed-point drivers in
+  :mod:`raft_tpu.solve.dynamics` route through it; the XLA twin is
+  :func:`raft_tpu.core.linalg6.solve_cx_fused`.
 * **Analytic adjoint, not a differentiated kernel.** The
   differentiable route (``method="scan"``, used by every
   gradient/co-design path) goes through :func:`solve_cx_pallas_ad`,
@@ -104,17 +116,16 @@ def enabled() -> bool:
         return False
 
 
-def _kernel(zr_ref, zi_ref, br_ref, bi_ref, xr_ref, xi_ref):
+def _eliminate(Ar, Ai, br, bi, xr_ref, xi_ref):
     """Unrolled 6x6 complex Gaussian elimination over a lane block.
 
-    Refs: zr/zi (36, B) row-major matrix entries, br/bi/xr/xi (6, B).
-    Every value below is a (1, B) vector; all arithmetic is elementwise
-    (VPU), and the per-lane pivot permutation is a one-hot blend.
+    ``Ar``/``Ai``: row-major lists of the 36 matrix-entry rows, ``br``/
+    ``bi``: lists of the 6 RHS rows — each a (1, B) VMEM-resident vector.
+    All arithmetic is elementwise (VPU), and the per-lane pivot
+    permutation is a one-hot blend.  Shared by the plain kernel (entries
+    loaded straight from HBM) and the fused assemble+solve kernel
+    (imaginary entries assembled in VMEM from ``Z0`` + ``w B_drag``).
     """
-    Ar = [zr_ref[i:i + 1, :] for i in range(_N * _N)]
-    Ai = [zi_ref[i:i + 1, :] for i in range(_N * _N)]
-    br = [br_ref[i:i + 1, :] for i in range(_N)]
-    bi = [bi_ref[i:i + 1, :] for i in range(_N)]
 
     def at(i, j):
         return i * _N + j
@@ -188,6 +199,35 @@ def _kernel(zr_ref, zi_ref, br_ref, bi_ref, xr_ref, xi_ref):
         xi_ref[i:i + 1, :] = xi[i]
 
 
+def _kernel(zr_ref, zi_ref, br_ref, bi_ref, xr_ref, xi_ref):
+    """Plain solve kernel: matrix entries read directly from the refs."""
+    _eliminate(
+        [zr_ref[i:i + 1, :] for i in range(_N * _N)],
+        [zi_ref[i:i + 1, :] for i in range(_N * _N)],
+        [br_ref[i:i + 1, :] for i in range(_N)],
+        [bi_ref[i:i + 1, :] for i in range(_N)],
+        xr_ref, xi_ref,
+    )
+
+
+def _fused_kernel(z0r_ref, z0i_ref, w_ref, bd_ref, br_ref, bi_ref,
+                  xr_ref, xi_ref):
+    """Fused assemble+solve kernel: ``Z = Z0 + i w B_drag`` is formed in
+    VMEM registers — the per-iteration complex impedance never exists as
+    an HBM tensor.  ``z0r``/``z0i``/``bd`` are (36, B) row-major entry
+    refs, ``w`` is (1, B); the imaginary entries are assembled lane-wise
+    right at load time and flow straight into the elimination."""
+    w = w_ref[0:1, :]
+    _eliminate(
+        [z0r_ref[i:i + 1, :] for i in range(_N * _N)],
+        [z0i_ref[i:i + 1, :] + w * bd_ref[i:i + 1, :]
+         for i in range(_N * _N)],
+        [br_ref[i:i + 1, :] for i in range(_N)],
+        [bi_ref[i:i + 1, :] for i in range(_N)],
+        xr_ref, xi_ref,
+    )
+
+
 @functools.partial(jax.jit, static_argnames=("block", "interpret"))
 def _solve_blocked(Zr, Zi, Fr, Fi, block: int, interpret: bool):
     """(Np, 6, 6)/(Np, 6) padded inputs -> (Np, 6) solution, via the
@@ -257,6 +297,149 @@ def solve_cx_pallas(A: Cx, b: Cx, block: int = _BLOCK,
     xr, xi = _solve_blocked(Zr, Zi, Fr, Fi, block, interpret)
     return Cx(xr[:n_sys].reshape(lead + (_N,)),
               xi[:n_sys].reshape(lead + (_N,)))
+
+
+@functools.partial(jax.jit, static_argnames=("block", "interpret"))
+def _solve_rao_blocked(Z0r, Z0i, W, Bd, Fr, Fi, block: int, interpret: bool):
+    """(Np, 6, 6)/(Np,)/(Np, 6, 6)/(Np, 6) padded inputs -> (Np, 6)
+    solution via the fused assemble+solve kernel on lane-major tiles."""
+    from jax.experimental import pallas as pl
+
+    Np = Z0r.shape[0]
+    grid = Np // block
+    z0r = Z0r.reshape(Np, _N * _N).T          # (36, Np)
+    z0i = Z0i.reshape(Np, _N * _N).T
+    bd = Bd.reshape(Np, _N * _N).T
+    w = W.reshape(Np, 1).T                    # (1, Np)
+    fr = Fr.T                                 # (6, Np)
+    fi = Fi.T
+    spec_z = pl.BlockSpec((_N * _N, block), lambda g: (0, g))
+    spec_w = pl.BlockSpec((1, block), lambda g: (0, g))
+    spec_f = pl.BlockSpec((_N, block), lambda g: (0, g))
+    xr, xi = pl.pallas_call(
+        _fused_kernel,
+        grid=(grid,),
+        in_specs=[spec_z, spec_z, spec_w, spec_z, spec_f, spec_f],
+        out_specs=[spec_f, spec_f],
+        out_shape=[
+            jax.ShapeDtypeStruct(fr.shape, fr.dtype),
+            jax.ShapeDtypeStruct(fi.shape, fi.dtype),
+        ],
+        interpret=interpret,
+    )(z0r, z0i, w, bd, fr, fi)
+    return xr.T, xi.T
+
+
+def solve_rao_pallas(Z0: Cx, w, B_drag, F: Cx, block: int = _BLOCK,
+                     interpret: bool | None = None) -> Cx:
+    """Fused RAO assemble+solve: ``x = (Z0 + i w B_drag)^-1 F``.
+
+    Kernel twin of :func:`raft_tpu.core.linalg6.solve_cx_fused` — the
+    per-iteration impedance assembly happens INSIDE the VMEM-resident
+    block, so the fixed point never writes or re-reads the full
+    (..., nw, 6, 6) complex ``Z`` in HBM: per iteration the kernel reads
+    the loop-invariant ``Z0`` pair, the scalar-per-lane ``w`` and the
+    (broadcast) real drag update, and writes only the (..., 6) solution.
+
+    ``Z0``: (..., nw, 6, 6) Cx; ``w``: broadcastable to the lead shape
+    (..., nw); ``B_drag``: (..., 6, 6) real, broadcast over the frequency
+    axis; ``F``: (..., nw, 6) Cx.  ``interpret`` defaults to True off-TPU.
+    """
+    if interpret is None:
+        interpret = jax.default_backend() != "tpu"
+    lead = Z0.re.shape[:-2]
+    n_sys = int(np.prod(lead)) if lead else 1
+    if n_sys == 0:
+        return Cx(jnp.zeros(lead + (_N,), dtype=Z0.re.dtype),
+                  jnp.zeros(lead + (_N,), dtype=Z0.re.dtype))
+    wb = jnp.broadcast_to(w, lead)
+    bd = jnp.broadcast_to(B_drag[..., None, :, :], lead + (_N, _N))
+    block = min(block, -(-n_sys // 128) * 128)
+    pad = (-n_sys) % block
+    Np = n_sys + pad
+
+    def prep(x, shape):
+        x = x.reshape((n_sys,) + shape)
+        if pad:
+            x = jnp.concatenate(
+                [x, jnp.zeros((pad,) + shape, dtype=x.dtype)], axis=0)
+        return x
+
+    Z0r = prep(Z0.re, (_N, _N))
+    Z0i = prep(Z0.im, (_N, _N))
+    # padded lanes solve the identity (w and B_drag pad as zeros, so the
+    # assembled pad matrix stays exactly the identity)
+    if pad:
+        eye = jnp.broadcast_to(jnp.eye(_N, dtype=Z0r.dtype), (pad, _N, _N))
+        Z0r = Z0r.at[n_sys:].set(eye)
+    W = prep(wb, ())
+    Bd = prep(bd, (_N, _N))
+    Fr = prep(F.re, (_N,))
+    Fi = prep(F.im, (_N,))
+    xr, xi = _solve_rao_blocked(Z0r, Z0i, W, Bd, Fr, Fi, block, interpret)
+    return Cx(xr[:n_sys].reshape(lead + (_N,)),
+              xi[:n_sys].reshape(lead + (_N,)))
+
+
+def _unbroadcast(x, shape):
+    """Reduce a cotangent produced at broadcast shape back onto the
+    primal's shape (sum over the broadcast axes)."""
+    while x.ndim > len(shape):
+        x = x.sum(axis=0)
+    for ax, (have, want) in enumerate(zip(x.shape, shape)):
+        if want == 1 and have != 1:
+            x = x.sum(axis=ax, keepdims=True)
+    return x
+
+
+@jax.custom_vjp
+def solve_rao_pallas_ad(Z0: Cx, w, B_drag, F: Cx) -> Cx:
+    """:func:`solve_rao_pallas` with an analytic reverse-mode rule.
+
+    Same adjoint structure as :func:`solve_cx_pallas_ad` — solve
+    ``A^H lam = xbar`` with ONE more call of the SAME fused kernel —
+    except the conjugate transpose is taken in the fused representation:
+    ``A = Z0 + i w B_drag`` gives ``A^H = Z0^H + i w (-B_drag^T)``, so
+    the adjoint solve is just the fused kernel on ``(Z0^H, w, -B_drag^T,
+    xbar)`` and the assembled adjoint impedance stays in VMEM too.  The
+    extra primals' cotangents follow from ``Z.im = Z0.im + w B_drag``:
+    ``B_dragbar = sum_w w * Abar.im`` (reduced over the frequency axis)
+    and ``wbar = sum_jk B_drag * Abar.im``.
+
+    Forward-mode (``jvp``/``jacfwd``) is NOT supported through this
+    wrapper (a ``custom_vjp`` limitation) — ``RAFT_TPU_PALLAS=0`` keeps
+    the fully transformable XLA path (``linalg6.solve_cx_fused``).
+    """
+    return solve_rao_pallas(Z0, w, B_drag, F)
+
+
+def _rao_ad_fwd(Z0: Cx, w, B_drag, F: Cx):
+    x = solve_rao_pallas(Z0, w, B_drag, F)
+    return x, (Z0, w, B_drag, x)
+
+
+def _rao_ad_bwd(res, xbar: Cx):
+    Z0, w, B_drag, x = res
+    Z0H = Cx(jnp.swapaxes(Z0.re, -1, -2), -jnp.swapaxes(Z0.im, -1, -2))
+    lam = solve_rao_pallas(Z0H, w, -jnp.swapaxes(B_drag, -1, -2), xbar)
+    # Abar = -conj(lam) x^T in the (re, im) pair algebra (see
+    # _solve_ad_bwd); Z = Z0 + i w B_drag then splits Abar onto the
+    # fused-representation primals.
+    lr, li = lam.re[..., :, None], lam.im[..., :, None]
+    xr, xi = x.re[..., None, :], x.im[..., None, :]
+    Abar = Cx(-(lr * xr + li * xi), lr * xi - li * xr)
+    lead = Z0.re.shape[:-2]
+    wb = jnp.broadcast_to(w, lead)
+    w_shape = jnp.shape(w)
+    wbar = _unbroadcast(
+        jnp.sum(B_drag[..., None, :, :] * Abar.im, axis=(-2, -1)), w_shape)
+    bdbar = _unbroadcast(
+        jnp.sum(wb[..., None, None] * Abar.im, axis=-3),
+        jnp.shape(B_drag))
+    return Abar, wbar, bdbar, Cx(lam.re, lam.im)
+
+
+solve_rao_pallas_ad.defvjp(_rao_ad_fwd, _rao_ad_bwd)
 
 
 @jax.custom_vjp

@@ -108,6 +108,13 @@ def main():
     if bench_json is not None:
         bench["json"] = bench_json
         bench["ok"] = bench["ok"] and bench_json.get("value") is not None
+        # surface the dispatch-ahead execution stats (chunks in flight,
+        # overlap fraction, donated bytes) as a first-class block so the
+        # pipeline regression story is one key deep, not four
+        pb = (bench_json.get("workloads", {})
+              .get("north_star_volturn_bem", {}).get("pipeline"))
+        if pb is not None:
+            bench["pipeline"] = pb
     else:
         bench["ok"] = False
         bench["error"] = "no JSON line found on bench stdout"

@@ -155,6 +155,39 @@ def _entry_val_grad():
     return fn, (jnp.asarray(1.0),), (jnp.asarray(1.05),)
 
 
+def _entry_fused_rao_solve():
+    """The fused assemble+solve entry (this PR's hot op): BOTH routes —
+    the Pallas kernel (interpreter mode off-TPU, the exact kernel the TPU
+    runs compiled) and the XLA fallback — traced together, so the audit's
+    zero-retrace / zero-f64 / zero-host-callback budgets cover the fused
+    path end to end (a ``pallas_call`` is a device op, not a host
+    callback; a leak would show here)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    from raft_tpu.core.cplx import Cx
+    from raft_tpu.core.linalg6 import solve_cx_fused
+    from raft_tpu.core.pallas6 import solve_rao_pallas
+
+    def mk(seed):
+        rng = np.random.default_rng(seed)
+        nw = 8
+        Z0 = Cx(jnp.asarray(rng.normal(size=(nw, 6, 6)) + 8.0 * np.eye(6)),
+                jnp.asarray(0.3 * rng.normal(size=(nw, 6, 6))))
+        w = jnp.asarray(rng.uniform(0.2, 2.5, nw))
+        Bd = jnp.asarray(rng.normal(size=(6, 6)))
+        F = Cx(jnp.asarray(rng.normal(size=(nw, 6))),
+               jnp.asarray(rng.normal(size=(nw, 6))))
+        return (Z0, w, Bd, F)
+
+    def fn(Z0, w, Bd, F):
+        xp = solve_rao_pallas(Z0, w, Bd, F)
+        xx = solve_cx_fused(Z0, w, Bd, F)
+        return xp.re + xx.re, xp.im + xx.im
+
+    return fn, mk(0), mk(1)
+
+
 def _entry_eigen():
     """Traced core of :func:`raft_tpu.solve.eigen.solve_eigen` — the
     generalized symmetric eigensolve (Cholesky + Jacobi sweeps)."""
@@ -188,6 +221,9 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("val_grad", "raft_tpu.parallel.optimize.optimize_design",
                _entry_val_grad),
     EntryPoint("eigen", "raft_tpu.solve.eigen.solve_eigen", _entry_eigen),
+    EntryPoint("fused_rao_solve",
+               "raft_tpu.core.pallas6.solve_rao_pallas",
+               _entry_fused_rao_solve),
 )
 
 

@@ -10,6 +10,12 @@ from raft_tpu.parallel.geometry import (  # noqa: F401
     make_stretch_draft,
     substructure_masks,
 )
+from raft_tpu.parallel.pipeline import (  # noqa: F401
+    PipelineStats,
+    dispatch_depth,
+    donation_enabled,
+    run_pipelined,
+)
 from raft_tpu.parallel.optimize import (  # noqa: F401
     energy_sum,
     grad_nacelle_accel_std,

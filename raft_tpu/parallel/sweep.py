@@ -59,6 +59,24 @@ def _bem_device_layout(bem):
     return A, B, jnp.asarray(Fb.real), jnp.asarray(Fb.imag)
 
 
+def _interp_rows_host(bgrid, F_all, betas_np):
+    """Host heading interpolation: (B,) headings -> (B,6,nw) complex
+    excitation rows off the staged grid."""
+    from raft_tpu.model import interp_heading_excitation
+
+    return np.stack([
+        interp_heading_excitation(np.asarray(bgrid), F_all, float(b))
+        for b in betas_np
+    ])
+
+
+def _rows_device_layout(F_rows):
+    """(B,6,nw) complex host rows -> frequency-leading device pair
+    (F_re[B,nw,6], F_im[B,nw,6])."""
+    Fb = np.moveaxis(F_rows, -1, 1)          # (B,nw,6)
+    return jnp.asarray(Fb.real), jnp.asarray(Fb.imag)
+
+
 def _stage_heading_rows(bem, betas_eval):
     """Stage a ``Model.calcBEM(headings=...)`` heading GRID for a batch of
     per-case headings: interpolate the excitation to each case's heading on
@@ -70,29 +88,24 @@ def _stage_heading_rows(bem, betas_eval):
     yet zeta-scaled.  The ONE staging convention shared by
     :func:`sweep_sea_states` and the co-design losses
     (:func:`raft_tpu.parallel.optimize.optimize_design`), so the heading
-    interpolation rule cannot drift between the two call sites.
+    interpolation rule cannot drift between the two call sites.  (The
+    chunked sweep stages its per-chunk rows through the same
+    ``_interp_rows_host`` / ``_rows_device_layout`` pair, uncached.)
     """
     from raft_tpu import cache as _cache
-    from raft_tpu.model import interp_heading_excitation
 
     bgrid, F_all, A_h, B_h = bem
     betas_np = np.asarray(betas_eval)
-
-    def _interp_rows():
-        return (np.stack([
-            interp_heading_excitation(np.asarray(bgrid), F_all, float(b))
-            for b in betas_np
-        ]),)                                 # (B,6,nw) complex
 
     # content-addressed staging cache: a 1,000-case DLC table re-runs this
     # host loop every process; the heading grid + eval headings key it
     (F_rows,) = _cache.cached_arrays(
         "heading_rows", (np.asarray(bgrid), np.asarray(F_all), betas_np),
-        _interp_rows,
+        lambda: (_interp_rows_host(bgrid, F_all, betas_np),),
     )
     A_dev, B_dev, _, _ = _bem_device_layout((A_h, B_h, F_rows[0]))
-    Fb = np.moveaxis(F_rows, -1, 1)          # (B,nw,6)
-    return A_dev, B_dev, jnp.asarray(Fb.real), jnp.asarray(Fb.imag)
+    F_re, F_im = _rows_device_layout(F_rows)
+    return A_dev, B_dev, F_re, F_im
 
 
 def _stage_zeta(staged, zeta):
@@ -454,6 +467,52 @@ def make_wave_states(w, cases, depth, g: float = 9.81) -> WaveState:
     )
 
 
+def _bem_mode(bem, betas_case) -> str:
+    """Classify and validate the ``bem`` argument of the batched
+    sea-state APIs: ``"none"``, the raw single-heading ``"raw"`` tuple,
+    or the staged heading ``"grid"``.  ONE validation (and one set of
+    error messages) shared by the single-call and chunked
+    :func:`sweep_sea_states` paths, so they cannot drift."""
+    if bem is None:
+        return "none"
+    if len(bem) == 4:
+        return "grid"
+    if betas_case is not None:
+        raise ValueError(
+            "cases vary the wave heading but bem is a single-heading "
+            "(A, B, F) tuple; pass the staged heading grid "
+            "(betas, F_all, A, B) from Model.calcBEM(headings=...) so "
+            "each case gets its own BEM excitation"
+        )
+    if isinstance(bem[2], Cx):
+        raise ValueError(
+            "sweep_sea_states expects the raw host (A[6,6,nw], B, "
+            "F complex) tuple or the staged heading grid from "
+            "Model.calcBEM(headings=...), not the stage_bem output "
+            "(F is a Cx): batched sea states re-stage per case, so "
+            "pass the pre-staging layout"
+        )
+    return "raw"
+
+
+def _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter):
+    """The per-case DLC solve (to be vmapped over the case axis) shared
+    by the single-call and chunked :func:`sweep_sea_states` paths — the
+    zeta scaling of the staged excitation is the only sea-state-dependent
+    part, so it happens per case lane."""
+    from raft_tpu.parallel.optimize import nacelle_accel_std
+
+    def one(wave, F_re, F_im):
+        # forward_response folds the lane's wave.beta into env itself
+        b = (_stage_zeta((staged[0], staged[1], F_re, F_im), wave.zeta)
+             if staged is not None else None)
+        out = forward_response(members, rna, env, wave, C_moor, bem=b,
+                               n_iter=n_iter)
+        return out.Xi.abs2(), nacelle_accel_std(out.Xi, wave, rna), out.n_iter
+
+    return one
+
+
 def sweep_sea_states(
     members: MemberSet,
     rna: RNA,
@@ -463,11 +522,30 @@ def sweep_sea_states(
     bem=None,
     n_iter: int = 25,
     mesh: Mesh | None = None,
+    chunk: int | None = None,
+    pipeline_depth: int | None = None,
 ):
     """One design x a batch of sea states in a single compiled call — the
     design-load-case (DLC) table evaluation of a WEIS outer loop.
     ``mesh``: optional 1-D device mesh; the case axis is embarrassingly
     parallel and shards across it (case count divisible by mesh size).
+
+    ``chunk``: split the case table into ``chunk``-sized sub-batches
+    (case count divisible by ``chunk``) executed through the
+    dispatch-ahead pipeline (:mod:`raft_tpu.parallel.pipeline`): the
+    host-side staging of chunk ``k+1`` — the per-case heading
+    interpolation and sea-state slicing — overlaps the device compute of
+    chunk ``k``, and with a heading-grid ``bem`` the per-chunk staged
+    excitation is DONATED to the compiled solve (its buffer is reused in
+    place for the ``Xi_abs2`` output; ``RAFT_TPU_DONATE=0`` opts out).
+    One chunk-sized executable is compiled and reused for every chunk;
+    results match the unchunked call to float eps (same per-lane
+    program, but XLA may vectorize the two batch sizes differently —
+    pinned at rtol=1e-12 on CPU in tests/test_pipeline.py) and the
+    returned dict gains a ``"pipeline"`` stats block.  ``pipeline_depth``
+    overrides the dispatch-ahead window (default
+    ``RAFT_TPU_PIPELINE_DEPTH`` or 2).  Mutually exclusive with
+    ``mesh`` (chunking is a single-device throughput feature).
 
     ``waves``: batched WaveState from :func:`make_wave_states` — all cases
     must share one uniform frequency grid (checked; the response integral
@@ -495,48 +573,36 @@ def sweep_sea_states(
     B = int(waves.zeta.shape[0])
     betas_case = None if waves.beta is None else np.asarray(waves.beta)
 
+    if chunk is not None:
+        if mesh is not None:
+            raise ValueError(
+                "chunked (pipelined) sweep_sea_states does not compose "
+                "with a mesh: chunking bounds single-device HBM while a "
+                "mesh shards the case axis — pick one")
+        return _sweep_sea_states_chunked(
+            members, rna, env, waves, C_moor, bem, n_iter,
+            int(chunk), pipeline_depth, B, betas_case)
+
     # pre-convert the coefficient layout once on host so the vmapped body
     # is pure jnp: per-case excitation (heading interpolation) and the zeta
     # scaling (the only sea-state-dependent parts) happen per case lane
+    mode = _bem_mode(bem, betas_case)
     staged = None        # (A[nw,6,6], B[nw,6,6]) device coefficient layout
     F_ax = None          # vmap axis of the excitation args (0 = per case)
-    if bem is not None:
-        if len(bem) == 4:                    # staged heading grid
-            betas_eval = (betas_case if betas_case is not None
-                          else np.full(B, float(env.beta)))
-            A_dev, B_dev, F_re_h, F_im_h = _stage_heading_rows(bem, betas_eval)
-            F_ax = 0                         # (B,nw,6) per-case excitation
-        elif betas_case is not None:
-            raise ValueError(
-                "cases vary the wave heading but bem is a single-heading "
-                "(A, B, F) tuple; pass the staged heading grid "
-                "(betas, F_all, A, B) from Model.calcBEM(headings=...) so "
-                "each case gets its own BEM excitation"
-            )
-        else:
-            if isinstance(bem[2], Cx):
-                raise ValueError(
-                    "sweep_sea_states expects the raw host (A[6,6,nw], B, "
-                    "F complex) tuple or the staged heading grid from "
-                    "Model.calcBEM(headings=...), not the stage_bem output "
-                    "(F is a Cx): batched sea states re-stage per case, so "
-                    "pass the pre-staging layout"
-                )
-            # one shared heading: stage the excitation ONCE, (nw,6), and
-            # broadcast it per lane via vmap in_axes=None — not B device
-            # copies (only the zeta scaling differs per case)
-            A_dev, B_dev, F_re_h, F_im_h = _bem_device_layout(bem)
+    if mode == "grid":                       # staged heading grid
+        betas_eval = (betas_case if betas_case is not None
+                      else np.full(B, float(env.beta)))
+        A_dev, B_dev, F_re_h, F_im_h = _stage_heading_rows(bem, betas_eval)
+        F_ax = 0                             # (B,nw,6) per-case excitation
+        staged = (A_dev, B_dev)
+    elif mode == "raw":
+        # one shared heading: stage the excitation ONCE, (nw,6), and
+        # broadcast it per lane via vmap in_axes=None — not B device
+        # copies (only the zeta scaling differs per case)
+        A_dev, B_dev, F_re_h, F_im_h = _bem_device_layout(bem)
         staged = (A_dev, B_dev)
 
-    from raft_tpu.parallel.optimize import nacelle_accel_std
-
-    def one(wave, F_re, F_im):
-        # forward_response folds the lane's wave.beta into env itself
-        b = (_stage_zeta((staged[0], staged[1], F_re, F_im), wave.zeta)
-             if staged is not None else None)
-        out = forward_response(members, rna, env, wave, C_moor, bem=b,
-                               n_iter=n_iter)
-        return out.Xi.abs2(), nacelle_accel_std(out.Xi, wave, rna), out.n_iter
+    one = _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter)
 
     # dummy excitation keeps one signature when bem is None
     F_re = F_re_h if staged is not None else jnp.zeros(())
@@ -577,6 +643,93 @@ def sweep_sea_states(
         "nacelle accel std dev": np.asarray(a_nac),
         "iterations": np.asarray(iters),
         "Xi_abs2": np.asarray(abs2),
+    }
+
+
+def _sweep_sea_states_chunked(members, rna, env, waves, C_moor, bem,
+                              n_iter, chunk, pipeline_depth, B, betas_case):
+    """Pipelined chunk execution of the DLC table (see
+    :func:`sweep_sea_states` ``chunk=``): per-chunk host staging
+    overlapped with device compute, heading-grid excitation donated."""
+    from raft_tpu import cache as _cache
+    from raft_tpu.parallel import pipeline as _pipe
+
+    if B % chunk != 0:
+        raise ValueError(f"{B} sea states not divisible by chunk={chunk}")
+
+    mode = _bem_mode(bem, betas_case)
+    grid_mode = mode == "grid"
+    staged = None        # (A[nw,6,6], B[nw,6,6]) loop-invariant layout
+    F_ax = None
+    F_re_all = F_im_all = None
+    betas_eval = None
+    if grid_mode:
+        F_ax = 0
+        betas_eval = (betas_case if betas_case is not None
+                      else np.full(B, float(env.beta)))
+        # coefficient layout staged ONCE; the per-chunk host work is the
+        # heading interpolation of that chunk's excitation rows
+        A_dev, B_dev, _, _ = _bem_device_layout(
+            (bem[2], bem[3], np.asarray(bem[1])[0]))
+        staged = (A_dev, B_dev)
+    elif mode == "raw":
+        A_dev, B_dev, F_re_all, F_im_all = _bem_device_layout(bem)
+        staged = (A_dev, B_dev)
+
+    one = _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter)
+
+    def stage(k):
+        sl = slice(k * chunk, (k + 1) * chunk)
+        wv = WaveState(
+            w=waves.w[sl], k=waves.k[sl], zeta=waves.zeta[sl],
+            beta=None if waves.beta is None else waves.beta[sl])
+        if grid_mode:
+            # rows-only per-chunk staging (UNcached: the work is exactly
+            # what the pipeline overlaps, and going through the staging
+            # cache here would re-content-hash the full heading grid —
+            # plus rebuild the already-staged A/B layout — every chunk)
+            F_re, F_im = _rows_device_layout(
+                _interp_rows_host(bem[0], bem[1], betas_eval[sl]))
+            return (wv, F_re, F_im)          # fresh buffers every chunk
+        if staged is not None:               # one shared heading: (nw,6)
+            return (wv, F_re_all, F_im_all)  # replicated via in_axes=None
+        z = jnp.zeros(())
+        return (wv, z, z)
+
+    # donation: only the per-case excitation real part has a usable alias
+    # (F_re (chunk,nw,6) is reused in place for the Xi_abs2 output, which
+    # has exactly that shape/dtype); donating the other staged leaves
+    # would find no matching output and only warn.  Freshly staged every
+    # chunk above, so the invalidation is safe by construction.
+    donate = grid_mode and _pipe.donation_enabled()
+    jit_kw = {"donate_argnums": (1,)} if donate else {}
+    # chunk 0 is staged once and reused for both the compile-example
+    # signature and its own dispatch (staging twice would re-hash the
+    # heading grid and re-transfer the excitation for nothing; the
+    # buffers are consumed only at dispatch, so the reuse is safe)
+    staged0 = stage(0)
+    fn = _cache.cached_callable(
+        "sweep_sea_states", jax.vmap(one, in_axes=(0, F_ax, F_ax)),
+        staged0,
+        consts=(members, rna, env, C_moor, staged or ()),
+        jit_kwargs=jit_kw,
+        extra=("n_iter", n_iter, "F_ax", F_ax, "chunk", chunk),
+    )
+    results, stats = _pipe.run_pipelined(
+        fn, range(B // chunk), depth=pipeline_depth,
+        stage=lambda k: staged0 if k == 0 else stage(k),
+        donate_argnums=(1,) if donate else (),
+    )
+    abs2 = np.concatenate([r[0] for r in results])
+    a_nac = np.concatenate([np.atleast_1d(r[1]) for r in results])
+    iters = np.concatenate([np.atleast_1d(r[2]) for r in results])
+    sigma = response_std(abs2, waves.w[0])
+    return {
+        "std dev": np.asarray(sigma),
+        "nacelle accel std dev": a_nac,
+        "iterations": iters,
+        "Xi_abs2": abs2,
+        "pipeline": stats.to_dict(),
     }
 
 
@@ -687,18 +840,28 @@ def sweep(
     apply_fn=scale_diameters,
     mesh: Mesh | None = None,
     n_iter: int = 25,
+    return_xi: bool = True,
 ):
     """Evaluate a batch of design variants, sharded over the mesh.
 
     ``thetas``: (B, ...) design-parameter batch; ``apply_fn(members, theta)``
     produces each variant.  Returns dict of per-design arrays (std devs,
     convergence iterations) pulled to host.
+
+    ``return_xi=False`` drops the full (B, nw, 6) ``Xi_abs2`` tensor from
+    the result: the response std dev is reduced ON DEVICE inside the
+    compiled sweep, so only the (B, 6) statistics (plus iteration counts)
+    cross the device->host boundary — the mode for throughput paths (the
+    bench) that never look at the raw spectra.  The statistics are
+    computed from the identical ``Xi`` either way.
     """
 
     def one(theta):
         m = apply_fn(members, theta)
         out = forward_response(m, rna, env, wave, C_moor, n_iter=n_iter)
-        return out.Xi.abs2(), out.n_iter
+        if return_xi:
+            return out.Xi.abs2(), out.n_iter
+        return response_std(out.Xi.abs2(), wave.w), out.n_iter
 
     from raft_tpu import cache as _cache
 
@@ -713,14 +876,20 @@ def sweep(
         "sweep", jax.vmap(one), (thetas,),
         consts=(members, rna, env, wave, C_moor),
         mesh=mesh, jit_kwargs=jit_kw,
-        extra=("n_iter", n_iter, *_cache.callable_salt(apply_fn)),
+        extra=("n_iter", n_iter, "return_xi", bool(return_xi),
+               *_cache.callable_salt(apply_fn)),
     )
-    abs2, iters = fn(thetas)
-    sigma = response_std(abs2, wave.w)
+    out0, iters = fn(thetas)
+    if return_xi:
+        sigma = response_std(out0, wave.w)
+        return {
+            "std dev": np.asarray(sigma),
+            "iterations": np.asarray(iters),
+            "Xi_abs2": np.asarray(out0),
+        }
     return {
-        "std dev": np.asarray(sigma),
+        "std dev": np.asarray(out0),
         "iterations": np.asarray(iters),
-        "Xi_abs2": np.asarray(abs2),
     }
 
 

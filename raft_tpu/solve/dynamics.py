@@ -26,7 +26,7 @@ from flax import struct
 
 from raft_tpu.core import cplx
 from raft_tpu.core.cplx import Cx
-from raft_tpu.core.linalg6 import solve_cx
+from raft_tpu.core.linalg6 import solve_cx_fused
 from raft_tpu.core.types import Env, MemberSet, WaveState
 from raft_tpu.hydro.strip import StripKin, linearized_drag
 
@@ -73,20 +73,30 @@ def impedance(w: Array, M: Array, B: Array, C: Array) -> Cx:
 
 def _solve_once(Z0: Cx, w: Array, B_drag: Array, F: Cx,
                 use_pallas: bool = False, differentiable: bool = False) -> Cx:
-    """One impedance solve with the current drag damping folded in.
+    """One FUSED impedance assemble+solve with the current drag damping.
+
+    The per-iteration ``Z = Z0 + i w B_drag`` is never materialized as a
+    standalone (..., nw, 6, 6) complex tensor: the Pallas route assembles
+    it inside the VMEM-resident kernel block
+    (:func:`~raft_tpu.core.pallas6.solve_rao_pallas`), and the XLA route
+    fuses the elementwise assembly into the elimination
+    (:func:`~raft_tpu.core.linalg6.solve_cx_fused`) — bit-comparable
+    expressions, so flipping the kernel knob cannot change convergence.
 
     ``differentiable`` picks the kernel variant with the analytic adjoint
-    rule (``solve_cx_pallas_ad``) so reverse-mode AD works through the
-    scan driver; the while driver keeps the plain kernel (a while_loop is
-    not reverse-differentiable anyway, and the plain variant still admits
-    whatever forward transforms the underlying pallas_call does).
+    rule (``solve_rao_pallas_ad``: the adjoint system ``A^H lam = xbar``
+    re-uses the SAME fused forward kernel on ``(Z0^H, w, -B_drag^T)``)
+    so reverse-mode AD works through the scan driver; the while driver
+    keeps the plain kernel (a while_loop is not reverse-differentiable
+    anyway, and the plain variant still admits whatever forward
+    transforms the underlying pallas_call does).
     """
-    Z = Z0 + Cx(jnp.zeros_like(Z0.re), w[..., None, None] * B_drag[..., None, :, :])
     if use_pallas:
-        from raft_tpu.core.pallas6 import solve_cx_pallas, solve_cx_pallas_ad
+        from raft_tpu.core.pallas6 import solve_rao_pallas, solve_rao_pallas_ad
 
-        return (solve_cx_pallas_ad if differentiable else solve_cx_pallas)(Z, F)
-    return solve_cx(Z, F)
+        return (solve_rao_pallas_ad if differentiable
+                else solve_rao_pallas)(Z0, w, B_drag, F)
+    return solve_cx_fused(Z0, w, B_drag, F)
 
 
 def _error(Xi: Cx, Xi_last: Cx, tol: float) -> Array:

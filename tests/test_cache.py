@@ -298,6 +298,49 @@ def test_aot_mem_disk_and_corruption(warm):
     assert rep["errors"] == 1 and rep["misses"] == 2
 
 
+def test_donation_salt_distinguishes_signatures():
+    """An executable compiled with donation must never be served to a
+    call site compiled without it (the donating one invalidates inputs
+    the other still holds): the donation signature is a key component."""
+    s_none = aot.donation_salt(None)
+    s_empty = aot.donation_salt({})
+    s_num = aot.donation_salt({"donate_argnums": (0,)})
+    s_num2 = aot.donation_salt({"donate_argnums": (0, 1)})
+    s_int = aot.donation_salt({"donate_argnums": 0})
+    s_name = aot.donation_salt({"donate_argnames": ("x",)})
+    assert s_none == s_empty
+    assert len({s_none, s_num, s_num2, s_name}) == 4
+    assert s_int == s_num                      # int normalizes to tuple
+    x = jnp.arange(4.0)
+    k_plain = aot.aot_key("t", (x,), extra=(s_none,))
+    k_donate = aot.aot_key("t", (x,), extra=(s_num,))
+    assert k_plain != k_donate
+
+
+def test_cached_compile_keys_on_donation(warm):
+    """Flipping donate_argnums compiles a SECOND executable (no stale
+    reuse across the aliasing flip), and the donating one really
+    invalidates its input buffer."""
+    def f(v):
+        return v * 2.0
+
+    x = jnp.arange(16.0)
+    plain = aot.cached_compile("don", f, (x,))
+    assert stats.report()["aot"]["misses"] == 1
+    donating = aot.cached_compile("don", f, (x,),
+                                  jit_kwargs={"donate_argnums": (0,)})
+    assert stats.report()["aot"]["misses"] == 2    # distinct key: recompiled
+    # same key on repeat: served from memory
+    aot.cached_compile("don", f, (x,), jit_kwargs={"donate_argnums": (0,)})
+    assert stats.report()["aot"]["mem_hits"] == 1
+    y = jnp.arange(16.0) + 1.0
+    ref = np.asarray(plain(y))
+    out = np.asarray(donating(y))
+    np.testing.assert_array_equal(out, ref)
+    assert y.is_deleted()                          # donation was real
+    assert not x.is_deleted()
+
+
 def test_cached_callable_off_is_plain_jit():
     cache.disable()
     x = jnp.ones(4)

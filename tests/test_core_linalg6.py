@@ -130,3 +130,51 @@ def test_solve_under_jit_grad():
     eps = 1e-6
     fd = (loss(1.0 + eps) - loss(1.0 - eps)) / (2 * eps)
     np.testing.assert_allclose(float(g), float(fd), rtol=1e-4)
+
+
+def test_solve_cx_fused_is_bitwise_unfused():
+    """``solve_cx_fused`` must be the EXACT unfused expression (explicit
+    ``Z = Z0 + i w B_drag`` assembly followed by ``solve_cx``) — the
+    fusion is the compiler's, not a reformulation, so the fixed-point
+    drivers cannot change numerics by routing through it."""
+    nw = 21
+    Z0 = Cx(jnp.asarray(rng.normal(size=(nw, 6, 6)) + 8 * np.eye(6)),
+            jnp.asarray(0.3 * rng.normal(size=(nw, 6, 6))))
+    w = jnp.asarray(rng.uniform(0.1, 3.0, nw))
+    Bd = jnp.asarray(rng.normal(size=(6, 6)))
+    F = Cx(jnp.asarray(rng.normal(size=(nw, 6))),
+           jnp.asarray(rng.normal(size=(nw, 6))))
+    Z = Cx(Z0.re, Z0.im + w[:, None, None] * Bd[None, :, :])
+    x_ref = linalg6.solve_cx(Z, F)
+    x_fus = linalg6.solve_cx_fused(Z0, w, Bd, F)
+    np.testing.assert_array_equal(np.asarray(x_fus.re), np.asarray(x_ref.re))
+    np.testing.assert_array_equal(np.asarray(x_fus.im), np.asarray(x_ref.im))
+    # and under jit (the form the drivers compile; XLA may reassociate
+    # the fused elementwise ops, so eps-level rather than bitwise)
+    x_jit = jax.jit(linalg6.solve_cx_fused)(Z0, w, Bd, F)
+    np.testing.assert_allclose(np.asarray(x_jit.re), np.asarray(x_ref.re),
+                               rtol=1e-12)
+
+
+def test_solve_cx_fused_grad_matches_unfused():
+    """``jax.grad`` through the fused expression equals grad through the
+    explicit assembly + solve — same graph, same adjoints."""
+    nw = 8
+    Z0 = Cx(jnp.asarray(rng.normal(size=(nw, 6, 6)) + 8 * np.eye(6)),
+            jnp.asarray(0.3 * rng.normal(size=(nw, 6, 6))))
+    w = jnp.asarray(rng.uniform(0.1, 3.0, nw))
+    Bd = jnp.asarray(rng.normal(size=(6, 6)))
+    F = Cx(jnp.asarray(rng.normal(size=(nw, 6))),
+           jnp.asarray(rng.normal(size=(nw, 6))))
+
+    def loss_fused(Bd):
+        x = linalg6.solve_cx_fused(Z0, w, Bd, F)
+        return jnp.sum(x.abs2())
+
+    def loss_unfused(Bd):
+        Z = Cx(Z0.re, Z0.im + w[:, None, None] * Bd[None, :, :])
+        return jnp.sum(linalg6.solve_cx(Z, F).abs2())
+
+    g_f = jax.grad(loss_fused)(Bd)
+    g_u = jax.grad(loss_unfused)(Bd)
+    np.testing.assert_allclose(np.asarray(g_f), np.asarray(g_u), rtol=1e-12)

@@ -391,7 +391,7 @@ def test_audit_registry_covers_required_entries():
 
     names = {e.name for e in ENTRY_POINTS}
     assert {"north_star_sweep", "dlc_solve", "freq_sharded_forward",
-            "val_grad", "eigen"} <= names
+            "val_grad", "eigen", "fused_rao_solve"} <= names
 
 
 def test_audit_jaxpr_detects_f64_leaves():

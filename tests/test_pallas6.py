@@ -16,8 +16,13 @@ import jax
 import jax.numpy as jnp
 
 from raft_tpu.core.cplx import Cx
-from raft_tpu.core.linalg6 import solve_cx
-from raft_tpu.core.pallas6 import solve_cx_pallas, solve_cx_pallas_ad
+from raft_tpu.core.linalg6 import assemble_impedance, solve_cx, solve_cx_fused
+from raft_tpu.core.pallas6 import (
+    solve_cx_pallas,
+    solve_cx_pallas_ad,
+    solve_rao_pallas,
+    solve_rao_pallas_ad,
+)
 
 
 def _random_systems(B, rng):
@@ -138,6 +143,98 @@ def test_solver_flag_switches_both_drivers(monkeypatch):
 
     g = jax.grad(loss)(jnp.asarray(1.0))
     assert np.isfinite(float(g)) and float(g) != 0.0
+
+
+def _random_rao_systems(nw, rng, batch=()):
+    """Well-conditioned fused-representation systems (Z0, w, B_drag, F)."""
+    lead = batch + (nw,)
+    Z0 = Cx(jnp.asarray(rng.normal(size=lead + (6, 6)) + 8 * np.eye(6)),
+            jnp.asarray(0.3 * rng.normal(size=lead + (6, 6))))
+    w = jnp.asarray(rng.uniform(0.1, 3.0, lead))
+    Bd = jnp.asarray(rng.normal(size=batch + (6, 6)))
+    F = Cx(jnp.asarray(rng.normal(size=lead + (6,))),
+           jnp.asarray(rng.normal(size=lead + (6,))))
+    return Z0, w, Bd, F
+
+
+def test_fused_kernel_matches_unfused_bitwise():
+    """Interpreter-mode ``solve_rao_pallas`` equals the UNFUSED pipeline
+    (explicit Z assembly -> ``solve_cx``) to machine epsilon on random
+    well-conditioned systems — including a lane count that engages the
+    pad path — and equals the fused XLA fallback the same way."""
+    Z0, w, Bd, F = _random_rao_systems(173, np.random.default_rng(10))
+    x_unfused = solve_cx(assemble_impedance(Z0, w, Bd), F)
+    x_xla = solve_cx_fused(Z0, w, Bd, F)
+    x_pal = solve_rao_pallas(Z0, w, Bd, F)
+    # the XLA fallback IS the unfused expression (same assembly, fused
+    # only by the compiler): bit-identical
+    np.testing.assert_array_equal(np.asarray(x_xla.re),
+                                  np.asarray(x_unfused.re))
+    np.testing.assert_array_equal(np.asarray(x_xla.im),
+                                  np.asarray(x_unfused.im))
+    for got, ref in ((x_pal.re, x_unfused.re), (x_pal.im, x_unfused.im)):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=0, atol=1e-13)
+
+
+def test_fused_kernel_pivoting_stressed():
+    """A permutation-matrix ``Z0`` with zero drag has a zero first pivot:
+    only the lane-wise one-hot pivot path inside the fused kernel solves
+    it (exactly) — the assembly fusion must not bypass pivoting."""
+    rng = np.random.default_rng(11)
+    P = np.zeros((6, 6))
+    P[np.arange(6), (np.arange(6) + 1) % 6] = 1.0
+    nw = 4
+    Z0 = Cx(jnp.asarray(np.broadcast_to(P, (nw, 6, 6)).copy()),
+            jnp.zeros((nw, 6, 6)))
+    w = jnp.zeros((nw,))                   # zero drag term: Z == P exactly
+    Bd = jnp.asarray(rng.normal(size=(6, 6)))
+    F = Cx(jnp.asarray(rng.normal(size=(nw, 6))),
+           jnp.asarray(rng.normal(size=(nw, 6))))
+    x = solve_rao_pallas(Z0, w, Bd, F)
+    res = np.einsum("ij,bj->bi", P, np.asarray(x.to_complex()))
+    np.testing.assert_allclose(res, np.asarray(F.to_complex()), atol=1e-15)
+
+
+def test_fused_adjoint_grad_matches_xla():
+    """Reverse-mode through ``solve_rao_pallas_ad`` (the fused-
+    representation adjoint: same kernel on ``(Z0^H, w, -B_drag^T)``)
+    equals reverse-mode through the XLA fused expression for ALL four
+    cotangents — including the frequency and drag-matrix ones that only
+    exist in the fused representation."""
+    Z0, w, Bd, F = _random_rao_systems(96, np.random.default_rng(12))
+
+    def make_loss(solver):
+        def loss(Z0, w, Bd, F):
+            x = solver(Z0, w, Bd, F)
+            return jnp.sum(x.re ** 2 + 0.7 * x.im ** 2 + 0.3 * x.re * x.im)
+        return loss
+
+    g_p = jax.grad(make_loss(solve_rao_pallas_ad), argnums=(0, 1, 2, 3))(
+        Z0, w, Bd, F)
+    g_r = jax.grad(make_loss(solve_cx_fused), argnums=(0, 1, 2, 3))(
+        Z0, w, Bd, F)
+    for got, ref, name in (
+            (g_p[0].re, g_r[0].re, "Z0.re"), (g_p[0].im, g_r[0].im, "Z0.im"),
+            (g_p[1], g_r[1], "w"), (g_p[2], g_r[2], "B_drag"),
+            (g_p[3].re, g_r[3].re, "F.re"), (g_p[3].im, g_r[3].im, "F.im")):
+        np.testing.assert_allclose(np.asarray(got), np.asarray(ref),
+                                   rtol=1e-9, atol=1e-11, err_msg=name)
+
+
+@pytest.mark.slow
+def test_fused_kernel_vmap_composes():
+    """The fused kernel batches under vmap (the design-sweep pattern:
+    per-lane Z0/F/B_drag, shared w)."""
+    Z0, w, Bd, F = _random_rao_systems(24, np.random.default_rng(13),
+                                       batch=(5,))
+    w1 = w[0]                                # shared frequency grid
+    x_v = jax.vmap(lambda z, bd, f: solve_rao_pallas(z, w1, bd, f))(Z0, Bd, F)
+    x_ref = jax.vmap(lambda z, bd, f: solve_cx_fused(z, w1, bd, f))(Z0, Bd, F)
+    np.testing.assert_allclose(np.asarray(x_v.re), np.asarray(x_ref.re),
+                               rtol=0, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(x_v.im), np.asarray(x_ref.im),
+                               rtol=0, atol=1e-13)
 
 
 def test_enabled_knob_parsing(monkeypatch):

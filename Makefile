@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke lint
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke lint
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -15,6 +15,9 @@ cache-smoke:     ## warm-start proof: tiny sweep twice in fresh processes,
 
 pipeline-smoke:  ## fused-kernel + dispatch-ahead + donation proof (CPU, < 60 s)
 	python -c "from raft_tpu.parallel.pipeline import _smoke; raise SystemExit(_smoke())"
+
+resilience-smoke:  ## kill/resume + NaN-quarantine + ladder-salvage proof (CPU, < 60 s)
+	python -m raft_tpu.resilience
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

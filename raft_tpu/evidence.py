@@ -115,6 +115,13 @@ def main():
               .get("north_star_volturn_bem", {}).get("pipeline"))
         if pb is not None:
             bench["pipeline"] = pb
+        # lane-health / checkpoint accounting (quarantined + salvaged
+        # lanes, ladder rungs, chunks resumed): degradation must be one
+        # key deep in the round artifact, never buried
+        rb = (bench_json.get("workloads", {})
+              .get("north_star_volturn_bem", {}).get("resilience"))
+        if rb is not None:
+            bench["resilience"] = rb
     else:
         bench["ok"] = False
         bench["error"] = "no JSON line found on bench stdout"

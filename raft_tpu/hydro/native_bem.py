@@ -19,7 +19,6 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
-import subprocess
 
 import numpy as np
 
@@ -32,17 +31,51 @@ _lib = None
 
 
 def _build_lib() -> str:
+    """Compile the shared library on demand — through the resilience
+    retry discipline: a HARD timeout on the ``g++`` child (a hung
+    toolchain — NFS stall, OOM-thrashing box — must never wedge a sweep
+    forever; ``RAFT_TPU_BUILD_TIMEOUT``, default 300 s), one bounded
+    retry with backoff for transient failures, and on final failure a
+    RuntimeError carrying a REDACTED tail of the compiler's stderr (the
+    diagnostic, safe for committed artifacts) instead of the full spew.
+    """
     os.makedirs(_LIB_DIR, exist_ok=True)
     src_mtime = os.path.getmtime(_SRC)
     if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
         return _LIB
+    from raft_tpu.resilience import retry as _retry
+
+    # compile to a tmp path and publish atomically: a timeout-KILLED g++
+    # can leave a partial object, and the mtime freshness check above
+    # would serve that corrupt .so to ctypes forever
+    tmp = _LIB + f".tmp.{os.getpid()}"
     cmd = [
         "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
-        _SRC, "-o", _LIB, "-lm",
+        _SRC, "-o", tmp, "-lm",
     ]
-    res = subprocess.run(cmd, capture_output=True, text=True)
-    if res.returncode != 0:
-        raise RuntimeError(f"BEM solver build failed:\n{res.stderr}")
+    timeout_s = _retry.build_timeout_s()
+    try:
+        _retry.retry_call(
+            lambda attempt: _retry.checked_subprocess(
+                cmd, timeout_s=timeout_s, describe="BEM solver g++ build"),
+            retries=2, backoff_s=2.0,
+            retry_on=(_retry.SubprocessFailed,),
+            describe="BEM solver build",
+        )
+        os.replace(tmp, _LIB)
+    except _retry.RetryExhausted as e:
+        last = e.last
+        tail = getattr(last, "stderr_tail", "") or str(last)[-300:]
+        raise RuntimeError(
+            f"BEM solver build failed after {e.attempts} attempt(s) "
+            f"({getattr(last, 'kind', 'error')}, timeout {timeout_s:.0f}s "
+            f"per attempt):\n{tail}") from e
+    finally:
+        if os.path.exists(tmp):
+            try:
+                os.remove(tmp)
+            except OSError:
+                pass
     return _LIB
 
 

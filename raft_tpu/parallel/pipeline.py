@@ -85,6 +85,15 @@ class PipelineStats:
     donated_bytes: int = 0
     donated_buffers: int = 0
     invalidated_buffers: int = 0
+    # resilience accounting (raft_tpu.resilience): chunks served from the
+    # durable checkpoint store vs dispatched to the device, checkpoint
+    # writes, corrupt artifacts detected (and recomputed), and injected
+    # faults applied by the test harness
+    chunks_resumed: int = 0
+    chunks_computed: int = 0
+    chunks_checkpointed: int = 0
+    ckpt_corrupt: int = 0
+    faults_injected: int = 0
 
     @property
     def overlap_fraction(self) -> float:
@@ -103,11 +112,16 @@ class PipelineStats:
             "donated_bytes": int(self.donated_bytes),
             "donated_buffers": int(self.donated_buffers),
             "invalidated_buffers": int(self.invalidated_buffers),
+            "chunks_resumed": int(self.chunks_resumed),
+            "chunks_computed": int(self.chunks_computed),
+            "chunks_checkpointed": int(self.chunks_checkpointed),
+            "ckpt_corrupt": int(self.ckpt_corrupt),
+            "faults_injected": int(self.faults_injected),
         }
 
 
 def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
-                  fetch=None, donate_argnums: tuple = ()):
+                  fetch=None, donate_argnums: tuple = (), ckpt=None):
     """Run ``fetch(fn(stage(item)))`` per item with dispatch-ahead overlap.
 
     ``fn``
@@ -134,10 +148,29 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
         the backend really invalidated them (``invalidated_buffers`` in
         the stats; a backend that could not use a donation leaves the
         buffer live, which is visible here rather than silent).
+    ``ckpt``
+        Optional :class:`raft_tpu.resilience.checkpoint.ChunkStore`.
+        Every fetched result is persisted (atomic npz + hashed manifest)
+        BEFORE the pass moves on, and a chunk already present in the
+        store is served from disk instead of staged/dispatched — the
+        resume path of a killed/preempted sweep.  A corrupt artifact is
+        detected by content hash and recomputed (``ckpt_corrupt``).
+        Chunk indices in the store are POSITIONS in ``items``; the
+        store's program key (see ``checkpoint.store_for``) is what makes
+        position-keyed results safe to reuse.
+
+    With ``RAFT_TPU_FAULT_INJECT`` armed (:mod:`raft_tpu.resilience.
+    faults`), the deterministic injection points live here: ``nan_chunk``
+    overwrites a fetched result (before any checkpoint write, exactly
+    like a device that produced NaNs) and ``kill_after_chunk`` hard-exits
+    after a chunk's fetch+checkpoint completes.  All host-side: arming a
+    fault never changes the compiled program.
 
     Returns ``(results, PipelineStats)`` with results in item order.
     """
     import jax
+
+    from raft_tpu.resilience import faults as _faults
 
     if depth is None:
         depth = dispatch_depth()
@@ -149,8 +182,9 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
     items = list(items)
     n = len(items)
     stats = PipelineStats(chunks=n, depth=depth)
+    faulty = _faults.active()        # one env read per pass, not per chunk
     results = []
-    in_flight: deque = deque()       # (dispatched out, donated arg leaves)
+    in_flight: deque = deque()   # (index, dispatched out, donated leaves)
     t_start = time.perf_counter()
 
     def timed_host(kind, thunk):
@@ -166,14 +200,35 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
         return out
 
     def drain_one():
-        pending, donated = in_flight.popleft()
-        results.append(timed_host("fetch", lambda: fetch(pending)))
+        k_done, pending, donated = in_flight.popleft()
+        res = timed_host("fetch", lambda: fetch(pending))
+        if faulty and _faults.chunk_fault("nan_chunk", k_done):
+            res = _faults.nan_results(res)
+            stats.faults_injected += 1
+        results.append(res)
         for leaf in donated:
             stats.donated_buffers += 1
             if getattr(leaf, "is_deleted", lambda: False)():
                 stats.invalidated_buffers += 1
+        if ckpt is not None:
+            ckpt.save(k_done, res)
+            stats.chunks_checkpointed += 1
+        if faulty:
+            _faults.maybe_kill_after_chunk(k_done)
 
     for k, item in enumerate(items):
+        if ckpt is not None:
+            cached = ckpt.load(k)
+            if cached is not None:
+                # chunks older than k are all in flight or done: drain
+                # them first so ``results`` stays in item order (a
+                # resume boundary briefly serializes — the durable
+                # result is worth the bubble)
+                while in_flight:
+                    drain_one()
+                results.append(cached)
+                stats.chunks_resumed += 1
+                continue
         staged = timed_host("stage", lambda: stage(item))
         donated = []
         if donate_argnums:
@@ -182,7 +237,8 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
             stats.donated_bytes += sum(
                 getattr(leaf, "nbytes", 0) for leaf in donated)
         out = fn(*staged) if isinstance(staged, tuple) else fn(staged)
-        in_flight.append((out, donated))
+        in_flight.append((k, out, donated))
+        stats.chunks_computed += 1
         stats.max_in_flight = max(stats.max_in_flight, len(in_flight))
         # fetch the oldest result only once the window is full (so the
         # youngest chunk's staging+dispatch happened before the oldest
@@ -190,6 +246,12 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
         # at most ``depth`` chunks are ever in flight
         while len(in_flight) >= depth or (k == n - 1 and in_flight):
             drain_one()
+    # the final item may have been resumed from the store with older
+    # chunks still pending — the loop's last-item drain never saw them
+    while in_flight:
+        drain_one()
+    if ckpt is not None:
+        stats.ckpt_corrupt = ckpt.corrupt
     stats.wall_s = time.perf_counter() - t_start
     return results, stats
 

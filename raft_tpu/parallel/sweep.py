@@ -139,6 +139,8 @@ def forward_response(
     n_iter: int = 25,
     method: str = "scan",
     remat: bool = False,
+    relax: float = 0.8,
+    tik: float = 0.0,
 ):
     """Design -> RAO solve: the pure forward pipeline (statics through Xi).
 
@@ -155,6 +157,11 @@ def forward_response(
     ``n_iter`` steps with post-convergence freezing — so keep the cap tight
     for gradient work.
     Returns the :class:`~raft_tpu.solve.RAOResult`.
+
+    ``relax``/``tik`` pass through to :func:`~raft_tpu.solve.solve_dynamics`
+    (under-relaxation factor / Tikhonov diagonal loading) — the knobs the
+    resilience escalation ladder turns when a quarantined lane is
+    re-solved; the defaults trace the exact pre-resilience program.
     """
     if wave.beta is not None:
         if jnp.ndim(wave.beta) != 0:
@@ -184,7 +191,7 @@ def forward_response(
         F=F,
     )
     return solve_dynamics(members, kin, wave, env, lin, n_iter=n_iter,
-                          method=method, remat=remat)
+                          method=method, remat=remat, relax=relax, tik=tik)
 
 
 def _sharding_commit(mesh):
@@ -495,11 +502,19 @@ def _bem_mode(bem, betas_case) -> str:
     return "raw"
 
 
-def _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter):
+def _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter,
+                      relax: float = 0.8, tik: float = 0.0,
+                      health: bool = False):
     """The per-case DLC solve (to be vmapped over the case axis) shared
     by the single-call and chunked :func:`sweep_sea_states` paths — the
     zeta scaling of the staged excitation is the only sea-state-dependent
-    part, so it happens per case lane."""
+    part, so it happens per case lane.  The escalation ladder re-uses the
+    SAME function unvmapped for its single-lane rungs (``relax``/``tik``
+    are the rung knobs), so a salvage solve cannot drift from the batch
+    solve.  ``health=True`` additionally returns the lane's device-side
+    verdict (converged flag + a finiteness reduction over the full
+    response spectra) — static flag, so the default path traces and
+    transfers exactly what it always did."""
     from raft_tpu.parallel.optimize import nacelle_accel_std
 
     def one(wave, F_re, F_im):
@@ -507,8 +522,12 @@ def _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter):
         b = (_stage_zeta((staged[0], staged[1], F_re, F_im), wave.zeta)
              if staged is not None else None)
         out = forward_response(members, rna, env, wave, C_moor, bem=b,
-                               n_iter=n_iter)
-        return out.Xi.abs2(), nacelle_accel_std(out.Xi, wave, rna), out.n_iter
+                               n_iter=n_iter, relax=relax, tik=tik)
+        abs2 = out.Xi.abs2()
+        res = (abs2, nacelle_accel_std(out.Xi, wave, rna), out.n_iter)
+        if health:
+            return res + (out.converged, jnp.isfinite(abs2).all())
+        return res
 
     return one
 
@@ -524,6 +543,8 @@ def sweep_sea_states(
     mesh: Mesh | None = None,
     chunk: int | None = None,
     pipeline_depth: int | None = None,
+    health: bool = False,
+    escalate: bool = True,
 ):
     """One design x a batch of sea states in a single compiled call — the
     design-load-case (DLC) table evaluation of a WEIS outer loop.
@@ -565,6 +586,18 @@ def sweep_sea_states(
     compiled sweep (the solver side of the grid is
     :func:`raft_tpu.model.solve_bem_heading_grid`, the capability of the
     reference's HAMS heading grids, hams/pyhams.py:196-289).
+
+    ``health=True`` turns on the resilience contract
+    (:mod:`raft_tpu.resilience`): every case lane gets a device-side
+    ``(converged, finite, n_iter)`` verdict, failed lanes are
+    QUARANTINED instead of poisoning the batch and — with ``escalate``
+    (the default) — re-solved through the escalation ladder (each rung
+    its own AOT-cached executable).  The result dict gains per-lane
+    ``"converged"``/``"finite"`` arrays and a ``"health"`` summary block
+    (quarantined/salvaged/rungs used); salvaged lanes' statistics are
+    patched in place, unsalvaged lanes stay NaN but are REPORTED.  Off
+    (the default) the call traces, transfers, and returns exactly what
+    it always did.
     """
     w_rows = np.asarray(waves.w)
     if not (w_rows == w_rows[0]).all():
@@ -581,7 +614,8 @@ def sweep_sea_states(
                 "mesh shards the case axis — pick one")
         return _sweep_sea_states_chunked(
             members, rna, env, waves, C_moor, bem, n_iter,
-            int(chunk), pipeline_depth, B, betas_case)
+            int(chunk), pipeline_depth, B, betas_case,
+            health=health, escalate=escalate)
 
     # pre-convert the coefficient layout once on host so the vmapped body
     # is pure jnp: per-case excitation (heading interpolation) and the zeta
@@ -602,7 +636,8 @@ def sweep_sea_states(
         A_dev, B_dev, F_re_h, F_im_h = _bem_device_layout(bem)
         staged = (A_dev, B_dev)
 
-    one = _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter)
+    one = _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter,
+                            health=health)
 
     # dummy excitation keeps one signature when bem is None
     F_re = F_re_h if staged is not None else jnp.zeros(())
@@ -634,23 +669,132 @@ def sweep_sea_states(
         (waves, F_re, F_im),
         consts=(members, rna, env, C_moor, staged or ()),
         mesh=mesh, jit_kwargs=jit_kw,
-        extra=("n_iter", n_iter, "F_ax", F_ax),
+        extra=("n_iter", n_iter, "F_ax", F_ax, "health", bool(health)),
     )
-    abs2, a_nac, iters = fn(waves, F_re, F_im)
+    outs = fn(waves, F_re, F_im)
+    abs2, a_nac, iters = outs[:3]
     sigma = response_std(abs2, waves.w[0])
-    return {
+    res = {
         "std dev": np.asarray(sigma),
         "nacelle accel std dev": np.asarray(a_nac),
         "iterations": np.asarray(iters),
         "Xi_abs2": np.asarray(abs2),
     }
+    if not health:
+        return res
+    if mode == "grid":
+        lane_F = lambda i: (F_re_h[i], F_im_h[i])          # noqa: E731
+    elif mode == "raw":
+        lane_F = lambda i: (F_re_h, F_im_h)                # noqa: E731
+    else:
+        z2 = jnp.zeros(())
+        lane_F = lambda i: (z2, z2)                        # noqa: E731
+    solve_lane = _dlc_lane_solver(members, rna, env, C_moor, staged,
+                                  waves, lane_F)
+    return _dlc_health_finish(res, outs[3], outs[4], waves, solve_lane,
+                              n_iter, escalate)
+
+
+def _dlc_lane_solver(members, rna, env, C_moor, staged, waves, lane_F):
+    """The escalation ladder's ``solve_lane`` callback over a DLC table:
+    ONE case re-solved alone with a rung's knobs, through the SAME
+    per-case function as the batch sweep (``_make_dlc_case_fn`` — a
+    salvage solve cannot drift from the batch solve) and its own
+    AOT-cached executable per rung.  ``lane_F(idx)`` supplies the lane's
+    excitation args (staged rows in grid mode, the shared pair in raw
+    mode, dummy zeros otherwise)."""
+    from raft_tpu import cache as _cache
+
+    # one executable per rung, not per lane: lanes share shapes, so the
+    # rung knobs fully determine the program — memoized here so the
+    # "a rung used twice compiles once" contract holds even with the
+    # warm-start cache disabled (where cached_callable returns a fresh
+    # jax.jit per call)
+    rung_fns: dict = {}
+
+    def solve_lane(idx, n_iter_r, relax_r, tik_r):
+        wv = WaveState(
+            w=waves.w[idx], k=waves.k[idx], zeta=waves.zeta[idx],
+            beta=None if waves.beta is None else waves.beta[idx])
+        F_re_i, F_im_i = lane_F(idx)
+        fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
+        if fn1 is None:
+            one_r = _make_dlc_case_fn(members, rna, env, C_moor, staged,
+                                      n_iter_r, relax=relax_r, tik=tik_r,
+                                      health=True)
+            fn1 = _cache.cached_callable(
+                "resilience.ladder.dlc", one_r, (wv, F_re_i, F_im_i),
+                consts=(members, rna, env, C_moor, staged or ()),
+                extra=("n_iter", n_iter_r, "relax", relax_r, "tik", tik_r),
+            )
+            rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
+        abs2_i, a_i, it_i, conv_i, fin_i = fn1(wv, F_re_i, F_im_i)
+        # host-side by contract: fn1 is the compiled rung executable,
+        # this driver fetches its outputs for the quarantine bookkeeping
+        return ((np.asarray(abs2_i), np.asarray(a_i), np.asarray(it_i)),  # graftlint: disable=GL106
+                bool(np.asarray(conv_i)), bool(np.asarray(fin_i)),  # graftlint: disable=GL102,GL106
+                int(np.asarray(it_i)))  # graftlint: disable=GL102,GL106
+
+    return solve_lane
+
+
+def _health_finish(res, conv, finite, payload_keys, solve_lane, n_iter,
+                   escalate, std_from=None, extra=None):
+    """Shared host-side health tail for every sweep path (design-theta
+    and sea-state, chunked and unchunked — one implementation so the
+    quarantine bookkeeping cannot drift between them): salvaged lanes
+    are patched in place into the ``payload_keys`` result arrays (the
+    ladder payload, in ``solve_lane``'s record order), ``"std dev"`` is
+    re-derived from the patched spectra when ``std_from=(key, w)``, and
+    per-lane verdict arrays plus the ``health`` summary block are
+    attached.  The healthy common case attaches the verdicts and
+    returns — no array copies, no std-dev recompute."""
+    from raft_tpu.resilience import health as _health
+    from raft_tpu.resilience import ladder as _ladder
+
+    conv = np.asarray(conv).astype(bool).reshape(-1)
+    finite = np.asarray(finite).astype(bool).reshape(-1)
+    host_arrays = [res[k] for k in payload_keys]
+    if not len(_health.failed_lanes(conv, finite, host_values=host_arrays)):
+        res["converged"] = conv
+        res["finite"] = finite
+        res["health"] = _health.summarize([], len(conv), extra=extra)
+        return res
+    payload = [np.array(res[k]) for k in payload_keys]
+    iters = payload[payload_keys.index("iterations")]
+    records, conv, finite = _ladder.quarantine_and_salvage(
+        payload, conv, finite, solve_lane, n_iter,
+        escalate=escalate, iters=iters)
+    for k, a in zip(payload_keys, payload):
+        res[k] = a
+    if std_from is not None:
+        key, w = std_from
+        res["std dev"] = np.asarray(response_std(jnp.asarray(res[key]), w))
+    res["converged"] = conv
+    res["finite"] = finite
+    res["health"] = _health.summarize(records, len(conv), extra=extra)
+    return res
+
+
+def _dlc_health_finish(res, conv, finite, waves, solve_lane, n_iter,
+                       escalate, extra=None):
+    """Sea-state-sweep instantiation of :func:`_health_finish`."""
+    return _health_finish(
+        res, conv, finite,
+        ["Xi_abs2", "nacelle accel std dev", "iterations"],
+        solve_lane, n_iter, escalate,
+        std_from=("Xi_abs2", waves.w[0]), extra=extra)
 
 
 def _sweep_sea_states_chunked(members, rna, env, waves, C_moor, bem,
-                              n_iter, chunk, pipeline_depth, B, betas_case):
+                              n_iter, chunk, pipeline_depth, B, betas_case,
+                              health=False, escalate=True):
     """Pipelined chunk execution of the DLC table (see
     :func:`sweep_sea_states` ``chunk=``): per-chunk host staging
-    overlapped with device compute, heading-grid excitation donated."""
+    overlapped with device compute, heading-grid excitation donated.
+    With ``RAFT_TPU_CKPT`` armed, every fetched chunk is persisted to the
+    durable chunk store (:mod:`raft_tpu.resilience.checkpoint`) and a
+    re-run resumes at the first missing chunk."""
     from raft_tpu import cache as _cache
     from raft_tpu.parallel import pipeline as _pipe
 
@@ -676,7 +820,8 @@ def _sweep_sea_states_chunked(members, rna, env, waves, C_moor, bem,
         A_dev, B_dev, F_re_all, F_im_all = _bem_device_layout(bem)
         staged = (A_dev, B_dev)
 
-    one = _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter)
+    one = _make_dlc_case_fn(members, rna, env, C_moor, staged, n_iter,
+                            health=health)
 
     def stage(k):
         sl = slice(k * chunk, (k + 1) * chunk)
@@ -708,29 +853,77 @@ def _sweep_sea_states_chunked(members, rna, env, waves, C_moor, bem,
     # heading grid and re-transfer the excitation for nothing; the
     # buffers are consumed only at dispatch, so the reuse is safe)
     staged0 = stage(0)
+    extra = ("n_iter", n_iter, "F_ax", F_ax, "chunk", chunk,
+             "health", bool(health))
     fn = _cache.cached_callable(
         "sweep_sea_states", jax.vmap(one, in_axes=(0, F_ax, F_ax)),
         staged0,
         consts=(members, rna, env, C_moor, staged or ()),
         jit_kwargs=jit_kw,
-        extra=("n_iter", n_iter, "F_ax", F_ax, "chunk", chunk),
+        extra=extra,
     )
+    # durable per-chunk result store (RAFT_TPU_CKPT): keyed exactly like
+    # the executable above, PLUS a content hash of the argument VALUES.
+    # The AOT key hashes call arguments abstractly (shape/dtype — right
+    # for an executable, which is input-value-agnostic), but stored
+    # RESULTS depend on the values: two DLC tables with identical shapes
+    # must land in different stores, or a resume would serve table A's
+    # responses for table B.  The hashed sources are the full sea-state
+    # table and the excitation-bearing bem arrays the per-chunk staging
+    # reads (A/B coefficient layouts are value-hashed via consts already).
+    from raft_tpu.resilience import checkpoint as _ckpt
+
+    data_leaves = [waves.w, waves.k, waves.zeta]
+    if waves.beta is not None:
+        data_leaves.append(waves.beta)
+    if grid_mode:
+        data_leaves += [bem[0], bem[1]]
+    elif staged is not None:
+        data_leaves += [F_re_all, F_im_all]
+    # (donation is NOT in the store key: it changes buffer aliasing, never
+    # results, so a resume stays valid across a RAFT_TPU_DONATE flip)
+    store = _ckpt.store_for(
+        "sweep_sea_states", staged0,
+        consts=(members, rna, env, C_moor, staged or ()),
+        extra=(*extra, "data_sha", _ckpt.content_hash(data_leaves)),
+        n_chunks=B // chunk)
     results, stats = _pipe.run_pipelined(
         fn, range(B // chunk), depth=pipeline_depth,
         stage=lambda k: staged0 if k == 0 else stage(k),
         donate_argnums=(1,) if donate else (),
+        ckpt=store,
     )
     abs2 = np.concatenate([r[0] for r in results])
     a_nac = np.concatenate([np.atleast_1d(r[1]) for r in results])
     iters = np.concatenate([np.atleast_1d(r[2]) for r in results])
     sigma = response_std(abs2, waves.w[0])
-    return {
+    res = {
         "std dev": np.asarray(sigma),
         "nacelle accel std dev": a_nac,
         "iterations": iters,
         "Xi_abs2": abs2,
         "pipeline": stats.to_dict(),
     }
+    if store is not None:
+        res["checkpoint"] = store.to_dict()
+    if not health:
+        return res
+    conv = np.concatenate([np.atleast_1d(r[3]) for r in results])
+    finite = np.concatenate([np.atleast_1d(r[4]) for r in results])
+    if grid_mode:
+        def lane_F(i):
+            F_re, F_im = _rows_device_layout(
+                _interp_rows_host(bem[0], bem[1], betas_eval[i:i + 1]))
+            return F_re[0], F_im[0]
+    elif staged is not None:
+        lane_F = lambda i: (F_re_all, F_im_all)            # noqa: E731
+    else:
+        z2 = jnp.zeros(())
+        lane_F = lambda i: (z2, z2)                        # noqa: E731
+    solve_lane = _dlc_lane_solver(members, rna, env, C_moor, staged,
+                                  waves, lane_F)
+    return _dlc_health_finish(res, conv, finite, waves, solve_lane,
+                              n_iter, escalate)
 
 
 def spread_sea_state(w, Hs, Tp, depth, beta0: float = 0.0, n_dir: int = 7,
@@ -841,6 +1034,8 @@ def sweep(
     mesh: Mesh | None = None,
     n_iter: int = 25,
     return_xi: bool = True,
+    health: bool = False,
+    escalate: bool = True,
 ):
     """Evaluate a batch of design variants, sharded over the mesh.
 
@@ -854,14 +1049,24 @@ def sweep(
     cross the device->host boundary — the mode for throughput paths (the
     bench) that never look at the raw spectra.  The statistics are
     computed from the identical ``Xi`` either way.
+
+    ``health=True``: the resilience contract (see
+    :func:`sweep_sea_states`) — per-lane device-side ``(converged,
+    finite, n_iter)`` verdicts (``finite`` reduced over the full spectra
+    even in ``return_xi=False`` mode, where they never cross to host),
+    quarantine of failed lanes, escalation-ladder salvage, and a
+    ``"health"`` summary block in the result.  Off by default: the fast
+    path is byte-identical to the pre-resilience sweep.
     """
 
     def one(theta):
         m = apply_fn(members, theta)
         out = forward_response(m, rna, env, wave, C_moor, n_iter=n_iter)
-        if return_xi:
-            return out.Xi.abs2(), out.n_iter
-        return response_std(out.Xi.abs2(), wave.w), out.n_iter
+        abs2 = out.Xi.abs2()
+        stat = abs2 if return_xi else response_std(abs2, wave.w)
+        if health:
+            return stat, out.n_iter, out.converged, jnp.isfinite(abs2).all()
+        return stat, out.n_iter
 
     from raft_tpu import cache as _cache
 
@@ -877,20 +1082,59 @@ def sweep(
         consts=(members, rna, env, wave, C_moor),
         mesh=mesh, jit_kwargs=jit_kw,
         extra=("n_iter", n_iter, "return_xi", bool(return_xi),
-               *_cache.callable_salt(apply_fn)),
+               "health", bool(health), *_cache.callable_salt(apply_fn)),
     )
-    out0, iters = fn(thetas)
+    outs = fn(thetas)
+    out0, iters = outs[:2]
     if return_xi:
         sigma = response_std(out0, wave.w)
-        return {
+        res = {
             "std dev": np.asarray(sigma),
             "iterations": np.asarray(iters),
             "Xi_abs2": np.asarray(out0),
         }
-    return {
-        "std dev": np.asarray(out0),
-        "iterations": np.asarray(iters),
-    }
+    else:
+        res = {
+            "std dev": np.asarray(out0),
+            "iterations": np.asarray(iters),
+        }
+    if not health:
+        return res
+
+    thetas_np = np.asarray(thetas)
+    rung_fns: dict = {}   # one executable per rung even with cache off
+
+    def solve_lane(idx, n_iter_r, relax_r, tik_r):
+        th = jnp.asarray(thetas_np[idx])
+        fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
+        if fn1 is None:
+            def f(theta, _n=n_iter_r, _r=relax_r, _t=tik_r):
+                m = apply_fn(members, theta)
+                out = forward_response(m, rna, env, wave, C_moor,
+                                       n_iter=_n, relax=_r, tik=_t)
+                abs2 = out.Xi.abs2()
+                stat = abs2 if return_xi else response_std(abs2, wave.w)
+                return (stat, out.n_iter, out.converged,
+                        jnp.isfinite(abs2).all())
+
+            fn1 = _cache.cached_callable(
+                "resilience.ladder.sweep", f, (th,),
+                consts=(members, rna, env, wave, C_moor),
+                extra=("n_iter", n_iter_r, "relax", relax_r, "tik", tik_r,
+                       "return_xi", bool(return_xi),
+                       *_cache.callable_salt(apply_fn)),
+            )
+            rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
+        stat, it, conv_i, fin_i = fn1(th)
+        return ((np.asarray(stat), np.asarray(it)),
+                bool(np.asarray(conv_i)), bool(np.asarray(fin_i)),
+                int(np.asarray(it)))
+
+    return _health_finish(
+        res, outs[2], outs[3],
+        ["Xi_abs2", "iterations"] if return_xi else ["std dev", "iterations"],
+        solve_lane, n_iter, escalate,
+        std_from=("Xi_abs2", wave.w) if return_xi else None)
 
 
 def grad_response_std(

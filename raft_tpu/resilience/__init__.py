@@ -1,0 +1,56 @@
+"""Resilient sweeps: lane quarantine, escalation retry, chunk
+checkpoint/resume, and a deterministic fault-injection harness.
+
+The north-star workload is a long-running chunked sweep over thousands
+of designs/sea states.  Before this subsystem, one diverged or NaN lane
+aborted the whole batch, a preempted run threw away every finished
+chunk, and a hung native-toolchain subprocess could stall a sweep
+forever.  The production contract is the opposite — partial progress is
+durable, bad cases are quarantined and REPORTED, the fleet keeps moving:
+
+* :mod:`~raft_tpu.resilience.health` — per-lane ``(converged, finite,
+  n_iter)`` verdicts computed device-side inside the compiled sweeps;
+  quarantine instead of batch abort; the ``RAFT_TPU_STRICT`` gate
+  (default ON) preserving the old all-or-nothing behavior where it
+  existed.
+* :mod:`~raft_tpu.resilience.ladder` — quarantined lanes re-solved
+  through an escalation ladder (bigger iteration budget → reduced
+  relaxation → Tikhonov-regularized fused solve), each rung its own
+  AOT-cached executable so the healthy path never recompiles.
+* :mod:`~raft_tpu.resilience.checkpoint` — durable per-chunk result
+  store (atomic npz + content-hashed manifest, keyed by the program's
+  AOT key) under ``RAFT_TPU_CKPT``; a killed sweep resumes at the first
+  missing chunk with bit-identical results.
+* :mod:`~raft_tpu.resilience.retry` — bounded, exponential-backoff,
+  deadline-aware retry + hard-timeout subprocess wrapper (the ``g++``
+  BEM build, the bench's backend probes) with shared stderr redaction.
+* :mod:`~raft_tpu.resilience.faults` — ``RAFT_TPU_FAULT_INJECT``
+  deterministic fault points (NaN chunk, kill-after-chunk, checkpoint
+  corruption, hanging subprocess), all host-side: arming a fault never
+  changes a traced program.
+
+``python -m raft_tpu.resilience`` runs the CPU smoke proving the
+kill-and-resume and NaN-quarantine-and-salvage paths end to end
+(``make resilience-smoke``, wired into the CI fast job).
+"""
+from raft_tpu.resilience.health import (  # noqa: F401
+    LaneHealth,
+    failed_lanes,
+    strict,
+    summarize,
+)
+from raft_tpu.resilience.ladder import (  # noqa: F401
+    RUNGS,
+    Rung,
+    escalate_lanes,
+    quarantine_and_salvage,
+    rung_knobs,
+)
+from raft_tpu.resilience.checkpoint import ChunkStore, store_for  # noqa: F401
+from raft_tpu.resilience.retry import (  # noqa: F401
+    RetryExhausted,
+    SubprocessFailed,
+    checked_subprocess,
+    redacted_tail,
+    retry_call,
+)

@@ -1,0 +1,211 @@
+"""Durable per-chunk result store: a killed sweep resumes, not restarts.
+
+The north-star workload is a chunked sweep over thousands of cases; a
+preemption at chunk 37/40 used to throw away 36 chunks of finished
+results.  This store makes partial progress durable, the same contract
+RAFT's reference encodes with its compute-once WAMIT-file pattern
+(SURVEY.md §5): each fetched chunk result is written as an atomic npz
+(tmp + ``os.replace``; a kill mid-write can never leave a truncated
+artifact that a later run would trust), indexed by a ``manifest.json``
+(also atomically replaced) that records a content hash per chunk.
+
+Keying: a store directory is named by the PROGRAM key — the same
+:func:`raft_tpu.cache.aot.aot_key` digest that names the compiled
+executable (argument signature + closure-consts hash + code fingerprint
++ topology + solver salts) plus the chunk count.  Any change to the
+code, the inputs, or the knobs lands in a different directory, so a
+resume can only ever be served results the CURRENT program would have
+computed — float-eps-identical by construction (bitwise, in fact: npz
+round-trips array bytes exactly).
+
+Corruption tolerance is absolute (the staging-cache rule): a missing,
+unreadable, truncated, or hash-mismatched chunk artifact counts as a
+miss — logged, counted, deleted, recomputed — never served.
+
+Armed by ``RAFT_TPU_CKPT``: unset/``off`` disables (the default — the
+fast path stages and writes NOTHING new); ``1``/``on`` roots the store
+under the cache root's ``ckpt/``; any other value is the root directory
+itself.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import tempfile
+
+import numpy as np
+
+_OFF = ("off", "0", "none", "disabled", "false", "no")
+
+
+def root() -> str | None:
+    """The checkpoint root this process would use, or None when disabled."""
+    v = os.environ.get("RAFT_TPU_CKPT", "").strip()
+    if not v or v.lower() in _OFF:
+        return None
+    if v.lower() in ("1", "on", "true", "yes"):
+        from raft_tpu.cache import config
+
+        base = config.cache_dir() or config.resolve_dir() or config.default_dir()
+        return os.path.join(base, "ckpt")
+    return os.path.abspath(os.path.expanduser(v))
+
+
+def enabled() -> bool:
+    return root() is not None
+
+
+def _leaf_hash(leaves) -> str:
+    h = hashlib.sha256()
+    for a in leaves:
+        a = np.ascontiguousarray(np.asarray(a))
+        h.update(f"{a.dtype.str}:{a.shape}:".encode())
+        h.update(a.tobytes())
+    return h.hexdigest()
+
+
+def content_hash(leaves) -> str:
+    """Value hash of a list of arrays, for folding input VALUES into a
+    store key.  The AOT key a store derives from hashes call arguments
+    abstractly (shape/dtype) — correct for executables, insufficient for
+    stored results, which depend on the values; callers fold this hash
+    of the value-bearing inputs into ``store_for``'s ``extra``."""
+    return _leaf_hash(leaves)[:16]
+
+
+class ChunkStore:
+    """Per-chunk result store for one (program, chunk-count) identity.
+
+    Results are flat tuples of host arrays (what the pipeline's fetch
+    step produces); a non-tuple result is stored and restored as the
+    bare array.  Construct via :func:`store_for` (which resolves the
+    root and derives the program key) rather than directly.
+    """
+
+    def __init__(self, key: str, n_chunks: int, base: str):
+        self.key = key
+        self.n_chunks = int(n_chunks)
+        self.dir = os.path.join(base, key)
+        os.makedirs(self.dir, exist_ok=True)
+        self._manifest_path = os.path.join(self.dir, "manifest.json")
+        self.saved = 0
+        self.resumed = 0
+        self.corrupt = 0
+        m = None
+        try:
+            with open(self._manifest_path) as f:
+                m = json.load(f)
+        except (OSError, json.JSONDecodeError, ValueError):
+            m = None
+        if (not isinstance(m, dict) or m.get("key") != key
+                or m.get("n_chunks") != self.n_chunks):
+            # unreadable manifest, or a stale store from a different
+            # program/chunking under a colliding path: start fresh
+            m = {"key": key, "n_chunks": self.n_chunks, "chunks": {}}
+        self._manifest = m
+
+    # ------------------------------------------------------------- paths
+
+    def _chunk_path(self, k: int) -> str:
+        return os.path.join(self.dir, f"chunk_{int(k)}.npz")
+
+    def _write_manifest(self) -> None:
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "w") as f:
+                json.dump(self._manifest, f)
+            os.replace(tmp, self._manifest_path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+
+    # --------------------------------------------------------------- api
+
+    def save(self, k: int, result) -> None:
+        """Persist chunk ``k``: atomic npz first, manifest second — a
+        kill between the two leaves an orphan file the manifest ignores
+        (recomputed next run), never a manifest entry without data."""
+        scalar = not isinstance(result, tuple)
+        leaves = [result] if scalar else list(result)
+        path = self._chunk_path(k)
+        fd, tmp = tempfile.mkstemp(dir=self.dir, suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                np.savez(f, **{f"arr_{i}": np.asarray(a)
+                               for i, a in enumerate(leaves)})
+            os.replace(tmp, path)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
+        from raft_tpu.resilience import faults
+
+        faults.maybe_corrupt_file("corrupt_ckpt", k, path)
+        self._manifest["chunks"][str(int(k))] = {
+            "sha": _leaf_hash(leaves), "n": len(leaves), "scalar": scalar,
+        }
+        self._write_manifest()
+        self.saved += 1
+
+    def _drop(self, k: int, why: str) -> None:
+        import warnings
+
+        warnings.warn(
+            f"checkpoint chunk {k} of {self.key} is unusable ({why}); "
+            f"it will be recomputed", stacklevel=3)
+        self.corrupt += 1
+        self._manifest["chunks"].pop(str(int(k)), None)
+        try:
+            os.unlink(self._chunk_path(k))
+        except OSError:
+            pass
+        self._write_manifest()
+
+    def load(self, k: int):
+        """Chunk ``k``'s stored result, or None (missing or corrupt —
+        a corrupt artifact is detected by content hash, logged, deleted,
+        and counted; it is NEVER returned)."""
+        entry = self._manifest["chunks"].get(str(int(k)))
+        if entry is None:
+            return None
+        try:
+            with np.load(self._chunk_path(k), allow_pickle=False) as z:
+                leaves = [z[f"arr_{i}"] for i in range(int(entry["n"]))]
+        except Exception:
+            self._drop(k, "unreadable/truncated npz")
+            return None
+        if _leaf_hash(leaves) != entry["sha"]:
+            self._drop(k, "content hash mismatch")
+            return None
+        self.resumed += 1
+        return leaves[0] if entry.get("scalar") else tuple(leaves)
+
+    def complete(self) -> bool:
+        return len(self._manifest["chunks"]) >= self.n_chunks
+
+    def to_dict(self) -> dict:
+        return {
+            "key": self.key,
+            "dir": self.dir,
+            "n_chunks": self.n_chunks,
+            "saved": self.saved,
+            "resumed": self.resumed,
+            "corrupt": self.corrupt,
+        }
+
+
+def store_for(tag: str, args, *, consts=(), extra=(), n_chunks: int,
+              mesh=None) -> ChunkStore | None:
+    """A :class:`ChunkStore` for the program identified exactly as the
+    AOT registry would key its executable, or None when ``RAFT_TPU_CKPT``
+    is off.  ``tag``/``args``/``consts``/``extra`` must mirror the
+    ``cached_callable``/``cached_compile`` call the chunks run through —
+    that is what makes resumed results program-identical."""
+    base = root()
+    if base is None:
+        return None
+    from raft_tpu.cache import aot
+
+    key = aot.aot_key(tag, args, consts=consts, mesh=mesh,
+                      extra=(*tuple(extra), "n_chunks", int(n_chunks)))
+    return ChunkStore(key[:24], n_chunks, base)

@@ -1,0 +1,214 @@
+"""``make resilience-smoke``: CPU proof of the whole resilience PR in < 60 s.
+
+Drives one small chunked OC3 DLC sweep through every resilience path,
+with REAL process boundaries (the properties being proven — durability
+across a kill, cross-process resume — cannot be faked in-process):
+
+1. **Reference** — the uninterrupted sweep, in-process, checkpointing
+   off.  Also warms the shared AOT disk cache so the child runs below
+   pay no repeat compiles.
+2. **Kill** — the same sweep in a child with
+   ``RAFT_TPU_FAULT_INJECT=kill_after_chunk:0`` and a checkpoint store
+   armed: the child must die with the harness's kill exit code AFTER
+   persisting chunk 0.
+3. **Resume** — the same child command without the fault: it must
+   resume chunk 0 from the manifest, recompute ONLY the missing chunk,
+   and its final results must match the uninterrupted reference to
+   float eps (bitwise in practice: same executable, npz round-trips
+   bytes exactly).
+4. **NaN quarantine + ladder** — the sweep with
+   ``RAFT_TPU_FAULT_INJECT=nan_chunk:1``: the poisoned chunk's lanes
+   must be quarantined (never silently dropped), salvaged through the
+   escalation ladder, reported in the health block, and land within
+   convergence tolerance of the reference.
+
+Prints one JSON line; rc 0 iff every check is green.
+"""
+# graftlint: disable-file=GL105 — host-side verification arithmetic only:
+# the f64 upcasts here are deliberate (a 1e-300 epsilon in the relative
+# error would underflow in the sweeps' f32), nothing in this module is
+# ever traced
+from __future__ import annotations
+
+import json
+import os
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+_CASES = [[6.0, 10.0], [7.0, 11.0], [8.0, 12.0], [9.0, 13.0]]
+_NW = 8
+_N_ITER = 8
+_CHUNK = 2
+
+
+def _smoke_case():
+    """The one tiny OC3 DLC workload every smoke step runs (4 sea
+    states, 2 chunks, strip theory only — the machinery under proof is
+    quarantine/checkpoint/ladder, not panel-solve physics)."""
+    from raft_tpu.model import stage_design_base
+    from raft_tpu.parallel.sweep import make_wave_states
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    design, members, rna, env, wave, C_moor = stage_design_base(
+        os.path.join(pkg, "designs", "OC3spar.yaml"),
+        nw=_NW, Hs=6.0, Tp=10.0, w_min=0.3, w_max=2.1)
+    depth = float(design["mooring"]["water_depth"])
+    waves = make_wave_states(np.asarray(wave.w), _CASES, depth)
+    return members, rna, env, waves, C_moor
+
+
+def _run_case():
+    from raft_tpu.parallel.sweep import sweep_sea_states
+
+    members, rna, env, waves, C_moor = _smoke_case()
+    return sweep_sea_states(members, rna, env, waves, C_moor,
+                            n_iter=_N_ITER, chunk=_CHUNK, health=True)
+
+
+def _smoke_child(out_path: str) -> int:
+    """Child body: run the smoke sweep under whatever RAFT_TPU_CKPT /
+    RAFT_TPU_FAULT_INJECT the parent armed, persist the results, print
+    one JSON stats line."""
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    from raft_tpu import cache
+
+    cache.enable()        # share the parent's AOT disk (RAFT_TPU_CACHE_DIR)
+    res = _run_case()
+    np.savez(out_path, std=res["std dev"],
+             a_nac=res["nacelle accel std dev"],
+             iters=res["iterations"], xi=res["Xi_abs2"],
+             conv=res["converged"], finite=res["finite"])
+    print(json.dumps({
+        "pipeline": res["pipeline"],
+        "checkpoint": res.get("checkpoint"),
+        "health": res["health"],
+    }))
+    return 0
+
+
+def _child_cmd(out_path: str):
+    return [sys.executable, "-m", "raft_tpu.resilience", "--child", out_path]
+
+
+def _smoke() -> int:
+    import shutil
+
+    tmp = tempfile.mkdtemp(prefix="raft_resilience_smoke_")
+    try:
+        return _smoke_body(tmp)
+    finally:
+        # the workspace holds multi-MB AOT/XLA caches + checkpoint npz
+        # per run — CI runs this on every build (cache smoke precedent)
+        shutil.rmtree(tmp, ignore_errors=True)
+
+
+def _smoke_body(tmp: str) -> int:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    from raft_tpu import cache
+    from raft_tpu.resilience import faults
+
+    cache_dir = os.path.join(tmp, "cache")
+    ckpt_dir = os.path.join(tmp, "ckpt")
+    base_env = {
+        **os.environ,
+        "JAX_PLATFORMS": "cpu",
+        "RAFT_TPU_CACHE_DIR": cache_dir,
+        "RAFT_TPU_STRICT": "0",
+        "RAFT_TPU_CKPT": "off",
+    }
+    base_env.pop("RAFT_TPU_FAULT_INJECT", None)
+
+    # 1. uninterrupted reference, in-process (warms the shared AOT disk)
+    os.environ.pop("RAFT_TPU_CKPT", None)
+    os.environ.pop("RAFT_TPU_FAULT_INJECT", None)
+    cache.enable(cache_dir)
+    ref = _run_case()
+    ref_healthy = bool(ref["health"]["n_quarantined"] == 0
+                       and np.isfinite(ref["std dev"]).all())
+    # f64 for the relative-error checks: the sweep's f32 results + a
+    # 1e-300 epsilon would underflow (numpy 2 weak-scalar promotion
+    # keeps f32), turning exact-zero columns into 0/0
+    ref_std = np.asarray(ref["std dev"], dtype=np.float64)
+    denom = np.abs(ref_std) + 1e-300
+
+    def run_child(tag, **env_over):
+        out_path = os.path.join(tmp, f"{tag}.npz")
+        r = subprocess.run(
+            _child_cmd(out_path), capture_output=True, text=True,
+            timeout=300, env={**base_env, **env_over},
+        )
+        line = (r.stdout.strip().splitlines() or ["{}"])[-1]
+        try:
+            stats = json.loads(line)
+        except json.JSONDecodeError:
+            stats = {}
+        return r.returncode, stats, out_path, r.stderr[-800:]
+
+    # 2. kill after chunk 0 (checkpoint store armed)
+    rc_kill, _, _, err_kill = run_child(
+        "kill", RAFT_TPU_CKPT=ckpt_dir,
+        RAFT_TPU_FAULT_INJECT="kill_after_chunk:0")
+    killed_ok = rc_kill == faults.KILL_EXIT
+    # the manifest must already hold chunk 0 — that is what the kill
+    # fault is timed to prove (persist first, die second)
+    n_ckpt_files = sum(
+        f.startswith("chunk_") for d, _, fs in os.walk(ckpt_dir) for f in fs)
+    persisted_ok = n_ckpt_files >= 1
+
+    # 3. resume: only the missing chunk recomputes; float-eps parity
+    rc_res, st_res, out_res, err_res = run_child(
+        "resume", RAFT_TPU_CKPT=ckpt_dir)
+    resumed = st_res.get("pipeline", {}).get("chunks_resumed", -1)
+    computed = st_res.get("pipeline", {}).get("chunks_computed", -1)
+    resume_ok = (rc_res == 0 and resumed == 1 and computed == 1)
+    parity = None
+    if rc_res == 0:
+        z = np.load(out_res)
+        parity = float(np.max(
+            np.abs(np.asarray(z["std"], np.float64) - ref_std) / denom))
+        resume_ok = bool(resume_ok and parity < 1e-12
+                         and bool(z["conv"].all()))
+
+    # 4. NaN chunk -> quarantine -> ladder salvage (no lane dropped)
+    rc_nan, st_nan, out_nan, err_nan = run_child(
+        "nan", RAFT_TPU_FAULT_INJECT="nan_chunk:1")
+    h = st_nan.get("health", {})
+    nan_lanes = list(range(_CHUNK, 2 * _CHUNK))      # chunk 1's lanes
+    nan_ok = (rc_nan == 0
+              and h.get("quarantined") == nan_lanes
+              and h.get("salvaged") == _CHUNK
+              and not h.get("unsalvaged"))
+    salvage_rel = None
+    if rc_nan == 0:
+        z = np.load(out_nan)
+        # zero lanes silently dropped: every lane finite, every lane
+        # within convergence tolerance of the uninterrupted reference
+        # (salvaged lanes ran more iterations — tol-level, not bitwise)
+        salvage_rel = float(np.max(
+            np.abs(np.asarray(z["std"], np.float64) - ref_std) / denom))
+        nan_ok = bool(nan_ok and np.isfinite(z["std"]).all()
+                      and np.isfinite(z["xi"]).all() and salvage_rel < 2e-2)
+
+    ok = bool(ref_healthy and killed_ok and persisted_ok and resume_ok
+              and nan_ok)
+    print(json.dumps({
+        "ok": ok,
+        "reference_healthy": ref_healthy,
+        "killed_with_expected_rc": killed_ok,
+        "chunk0_persisted_before_kill": persisted_ok,
+        "resume": {"ok": resume_ok, "chunks_resumed": resumed,
+                   "chunks_recomputed": computed,
+                   "max_rel_vs_uninterrupted": parity},
+        "nan_quarantine": {"ok": nan_ok, "health": h,
+                           "max_rel_vs_uninterrupted": salvage_rel},
+        "wall_s": round(time.perf_counter() - t0, 2),
+        **({} if ok else {"stderr_tails": {
+            "kill": err_kill[-300:], "resume": err_res[-300:],
+            "nan": err_nan[-300:]}}),
+    }))
+    return 0 if ok else 1

@@ -119,6 +119,7 @@ def solve_dynamics(
     axis_name: str | None = None,
     remat: bool = False,
     history: bool = False,
+    tik: float = 0.0,
 ) -> RAOResult:
     """Solve Xi(w) by fixed-point drag linearization (raft/raft.py:1469-1552).
 
@@ -150,6 +151,15 @@ def solve_dynamics(
     that the reference serves with per-iterate RAO plots
     (raft/raft.py:1536-1539).  Static flag, so the default hot path carries
     no history buffer.
+
+    ``tik`` > 0 applies Tikhonov-style diagonal loading to the response-
+    independent impedance: each frequency's ``Z0`` diagonal is lifted by
+    ``tik`` times that frequency's largest diagonal magnitude before the
+    fused assemble+solve.  This is the escalation ladder's last rung
+    (:mod:`raft_tpu.resilience.ladder`) — it trades a bounded, REPORTED
+    bias for solvability when the impedance is near-singular at some
+    bin.  Static knob: ``tik=0.0`` (the default and every healthy path)
+    traces the exact unregularized program.
     """
     # Pallas kernel for the batched 6x6 solves (auto-on on TPU, where it
     # is measured 18x faster end-to-end — core/pallas6.py), both drivers:
@@ -168,13 +178,13 @@ def solve_dynamics(
     return _solve_dynamics_impl(
         m, kin, wave, env, lin, n_iter=n_iter, tol=tol, relax=relax,
         method=method, axis_name=axis_name, remat=remat, history=history,
-        use_pallas=use_pallas,
+        use_pallas=use_pallas, tik=tik,
     )
 
 
 @partial(jax.jit, static_argnames=("n_iter", "tol", "relax", "method",
                                    "axis_name", "remat", "history",
-                                   "use_pallas"))
+                                   "use_pallas", "tik"))
 def _solve_dynamics_impl(
     m: MemberSet,
     kin: StripKin,
@@ -189,12 +199,30 @@ def _solve_dynamics_impl(
     remat: bool,
     history: bool,
     use_pallas: bool,
+    tik: float = 0.0,
 ) -> RAOResult:
     nw = wave.w.shape[-1]
     dtype = lin.C.dtype
 
     Xi0 = Cx(jnp.full((nw, 6), 0.1, dtype=dtype), jnp.zeros((nw, 6), dtype=dtype))
     Z0 = impedance(wave.w, lin.M, lin.B, lin.C)
+    if tik:
+        # Tikhonov-style diagonal loading (ladder rung): lift each
+        # frequency's diagonal by tik x its own largest diagonal
+        # magnitude, scale-free across hulls.  The shift follows the
+        # sign of each real diagonal entry — Re(Z_jj) = C_jj - w^2 M_jj
+        # is negative above that DOF's resonance, where an unconditional
+        # +lam would move the entry TOWARD zero and worsen conditioning.
+        # Python-level branch on a static knob — the tik=0 hot path
+        # traces zero extra ops.
+        d_re = jnp.diagonal(Z0.re, axis1=-2, axis2=-1)
+        dmag = jnp.sqrt(
+            jnp.square(d_re)
+            + jnp.square(jnp.diagonal(Z0.im, axis1=-2, axis2=-1)))
+        lam = tik * jnp.max(dmag, axis=-1)
+        shift = jnp.where(d_re >= 0, 1.0, -1.0) * lam[..., None]
+        Z0 = Cx(Z0.re + shift[..., None] * jnp.eye(6, dtype=dtype),
+                Z0.im)
 
     def step(Xi_last):
         B_drag, F_drag = linearized_drag(m, kin, Xi_last, wave, env,

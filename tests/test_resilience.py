@@ -1,0 +1,629 @@
+"""Resilience subsystem tests: lane quarantine + escalation ladder,
+chunk checkpoint/resume, fault injection, and the shared retry
+discipline.
+
+Covers this PR's robustness claims:
+
+* degenerate sea-state inputs (Hs=0, Tp=0) through ``sweep_sea_states``
+  produce a QUARANTINE verdict, never silent NaNs (the pre-resilience
+  behavior: a NaN spectrum integrated to an innocent-looking 0.0);
+* a lane that merely ran out of iterations is salvaged by the
+  escalation ladder and reported, with the batch result patched in
+  place;
+* a truncated or bit-flipped checkpoint npz is detected by content
+  hash, logged, recomputed — never crashes, never serves bad data;
+* a killed-and-rerun chunked sweep resumes from the manifest and
+  recomputes only the missing chunks, with identical results;
+* ``retry_call``/``checked_subprocess`` are bounded, backoff- and
+  deadline-aware, and redact credentials from committed diagnostics.
+"""
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+import jax
+import jax.numpy as jnp
+
+from raft_tpu.resilience import checkpoint, faults, health, ladder, retry
+
+
+# ------------------------------------------------------------------ health
+
+
+def test_strict_env_parsing(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_STRICT", raising=False)
+    assert health.strict() is True          # unset means strict: the default
+    for on in ("1", "on", "true", "STRICT"):
+        monkeypatch.setenv("RAFT_TPU_STRICT", on)
+        assert health.strict() is True
+    for off in ("0", "false", "OFF", "no"):
+        monkeypatch.setenv("RAFT_TPU_STRICT", off)
+        assert health.strict() is False
+
+
+def test_failed_lanes_catches_host_nans_past_device_flags():
+    """A lane whose fetched arrays went non-finite is quarantined even
+    when the device-side flags say healthy (fetch-path corruption,
+    injected faults)."""
+    conv = np.array([True, True, True, True])
+    vals = np.ones((4, 3))
+    vals[2, 1] = np.nan
+    bad = health.failed_lanes(conv, None, host_values=(vals,))
+    assert list(bad) == [2]
+    # device flags alone
+    assert list(health.failed_lanes([True, False, True])) == [1]
+    # finite flag composes
+    assert list(health.failed_lanes([True, True], [False, True])) == [0]
+
+
+def test_summarize_counts_rungs_and_unsalvaged():
+    recs = [
+        health.LaneHealth(3, True, True, 12, quarantined=True,
+                          salvaged=True, rung="n_iter_x4"),
+        health.LaneHealth(7, False, False, 48, quarantined=True),
+    ]
+    s = health.summarize(recs, 10, extra={"strict": False})
+    assert s["lanes"] == 10
+    assert s["n_quarantined"] == 2
+    assert s["quarantined"] == [3, 7]
+    assert s["salvaged"] == 1
+    assert s["unsalvaged"] == [7]
+    assert s["rungs_used"] == {"n_iter_x4": 1}
+    assert s["strict"] is False
+    json.dumps(s)                     # bench embeds it: must be JSON-clean
+
+
+# ------------------------------------------------------------------ ladder
+
+
+def test_rung_knobs_resolve():
+    n, r, t = ladder.rung_knobs(ladder.RUNGS[0], 8)
+    assert (n, r, t) == (32, ladder.DEFAULT_RELAX, 0.0)
+    n, r, t = ladder.rung_knobs(ladder.RUNGS[3], 8)
+    assert n == 48 and r == 0.5 and t == 1e-6
+    # tiny budgets still escalate by at least one iteration
+    assert ladder.rung_knobs(ladder.RUNGS[0], 0)[0] >= 1
+
+
+def test_escalate_lanes_salvages_at_correct_rung():
+    """A fake lane solver that only converges at relax=0.25: the ladder
+    must walk past the first two rungs and report the third."""
+    calls = []
+
+    def solve_lane(idx, n_iter, relax, tik):
+        calls.append((idx, n_iter, relax, tik))
+        ok = relax == 0.25
+        val = np.full(3, 1.0 if ok else np.nan)
+        return (val,), ok, ok, n_iter
+
+    records, salvaged = ladder.escalate_lanes([5], solve_lane, 8)
+    assert len(records) == 1 and records[0].salvaged
+    assert records[0].rung == "relax_0.25"
+    assert 5 in salvaged
+    assert [c[2] for c in calls] == [ladder.DEFAULT_RELAX, 0.5, 0.25]
+
+
+def test_escalate_lanes_rejects_nan_payload_despite_flags():
+    """A rung whose flags claim success but whose payload is NaN must
+    NOT count as salvage (NaN in -> 'converged' NaN out)."""
+
+    def solve_lane(idx, n_iter, relax, tik):
+        return (np.full(2, np.nan),), True, True, n_iter
+
+    records, salvaged = ladder.escalate_lanes([0], solve_lane, 4)
+    assert not records[0].salvaged and salvaged == {}
+    assert records[0].rung is None
+
+
+def test_quarantine_and_salvage_patches_arrays_in_place():
+    vals = np.array([[1.0, 1.0], [np.nan, np.nan], [3.0, 3.0]])
+    iters = np.array([4, 4, 4])
+    conv = np.array([True, False, True])
+
+    def solve_lane(idx, n_iter, relax, tik):
+        return (np.array([9.0, 9.0]), np.array(n_iter)), True, True, n_iter
+
+    records, conv2, fin2 = ladder.quarantine_and_salvage(
+        [vals, iters], conv, None, solve_lane, 4)
+    assert [r.index for r in records] == [1]
+    assert records[0].salvaged
+    np.testing.assert_array_equal(vals[1], [9.0, 9.0])
+    assert iters[1] == 16                       # the rung's budget, patched
+    assert conv2.all() and fin2.all()
+    # healthy batch: zero records, nothing touched
+    recs, _, _ = ladder.quarantine_and_salvage(
+        [np.ones((2, 2))], np.array([True, True]), None, solve_lane, 4)
+    assert recs == []
+
+
+# ------------------------------------------------------------------ faults
+
+
+def test_fault_spec_parsing(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_FAULT_INJECT", raising=False)
+    assert faults.specs() == {} and not faults.active()
+    monkeypatch.setenv("RAFT_TPU_FAULT_INJECT",
+                       "nan_chunk:3,kill_after_chunk:5,hang_subprocess")
+    assert faults.active()
+    assert faults.specs() == {"nan_chunk": [3], "kill_after_chunk": [5],
+                              "hang_subprocess": [None]}
+    assert faults.chunk_fault("nan_chunk", 3)
+    assert not faults.chunk_fault("nan_chunk", 2)
+    monkeypatch.setenv("RAFT_TPU_FAULT_INJECT", "nan_chunk")
+    assert faults.chunk_fault("nan_chunk", 17)  # argless targets every chunk
+    monkeypatch.setenv("RAFT_TPU_FAULT_INJECT", "nan_chunk:xyz")
+    with pytest.warns(UserWarning, match="non-integer"):
+        assert faults.specs() == {}             # malformed: ignored, loud
+
+
+def test_fault_consume_counted(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FAULT_INJECT", "hang_subprocess:2")
+    faults.reset_counts()
+    try:
+        assert faults.consume("hang_subprocess")
+        assert faults.consume("hang_subprocess")
+        assert not faults.consume("hang_subprocess")   # budget spent
+    finally:
+        faults.reset_counts()
+
+
+def test_nan_results_spares_flags_and_counts():
+    res = (np.ones((2, 3)), np.array([7, 9]), np.array([True, True]))
+    out = faults.nan_results(res)
+    assert np.isnan(out[0]).all()
+    np.testing.assert_array_equal(out[1], [7, 9])      # int: untouched
+    np.testing.assert_array_equal(out[2], [True, True])
+    assert np.isnan(faults.nan_results(np.zeros(4))).all()  # bare array
+
+
+def test_maybe_corrupt_file_flips_one_byte(tmp_path, monkeypatch):
+    p = tmp_path / "x.bin"
+    p.write_bytes(b"\x00" * 64)
+    monkeypatch.setenv("RAFT_TPU_FAULT_INJECT", "corrupt_ckpt:2")
+    assert not faults.maybe_corrupt_file("corrupt_ckpt", 1, str(p))
+    assert p.read_bytes() == b"\x00" * 64
+    assert faults.maybe_corrupt_file("corrupt_ckpt", 2, str(p))
+    data = p.read_bytes()
+    assert len(data) == 64 and sum(b != 0 for b in data) == 1
+
+
+# ------------------------------------------------------------------- retry
+
+
+def test_retry_call_bounded_with_exponential_backoff():
+    sleeps = []
+    attempts = []
+
+    def fn(attempt):
+        attempts.append(attempt)
+        raise ValueError(f"boom {attempt}")
+
+    with pytest.raises(retry.RetryExhausted) as ei:
+        retry.retry_call(fn, retries=3, backoff_s=1.0, growth=2.0,
+                         sleep=sleeps.append, describe="unit")
+    assert attempts == [0, 1, 2]
+    assert sleeps == [1.0, 2.0]                 # exponential, capped count
+    assert ei.value.attempts == 3
+    assert isinstance(ei.value.last, ValueError)
+
+
+def test_retry_call_succeeds_midway_and_notifies():
+    seen = []
+
+    def fn(attempt):
+        if attempt < 1:
+            raise OSError("transient")
+        return "ok"
+
+    out = retry.retry_call(fn, retries=3, sleep=lambda s: None,
+                           on_retry=lambda a, e: seen.append((a, str(e))))
+    assert out == "ok"
+    assert seen == [(0, "transient")]
+
+
+def test_retry_call_non_matching_exception_propagates_immediately():
+    calls = []
+
+    def fn(attempt):
+        calls.append(attempt)
+        raise KeyError("deterministic bug")
+
+    with pytest.raises(KeyError):
+        retry.retry_call(fn, retries=5, retry_on=(OSError,),
+                         sleep=lambda s: None)
+    assert calls == [0]                          # no backoff budget burned
+
+
+def test_retry_call_deadline_skips_pointless_sleep():
+    """When the next backoff would cross the deadline, the ladder stops
+    early instead of sleeping into it."""
+    sleeps = []
+
+    with pytest.raises(retry.RetryExhausted) as ei:
+        retry.retry_call(
+            lambda a: (_ for _ in ()).throw(ValueError("x")),
+            retries=10, backoff_s=100.0, deadline_s=1.0,
+            sleep=sleeps.append)
+    assert sleeps == []                          # never slept 100 s
+    assert ei.value.attempts == 1
+
+
+def test_checked_subprocess_ok_nonzero_and_timeout():
+    r = retry.checked_subprocess(
+        [sys.executable, "-c", "print('hi')"], timeout_s=60)
+    assert r.stdout.strip() == "hi"
+
+    with pytest.raises(retry.SubprocessFailed) as ei:
+        retry.checked_subprocess(
+            [sys.executable, "-c",
+             "import sys; print('tok api_key=SECRET123', file=sys.stderr);"
+             "sys.exit(3)"],
+            timeout_s=60, describe="unit")
+    assert ei.value.kind == "nonzero" and ei.value.returncode == 3
+    assert "SECRET123" not in ei.value.stderr_tail
+    assert "[redacted]" in ei.value.stderr_tail
+
+    with pytest.raises(retry.SubprocessFailed) as ei:
+        retry.checked_subprocess(
+            [sys.executable, "-c", "import time; time.sleep(60)"],
+            timeout_s=0.5, describe="unit")
+    assert ei.value.kind == "timeout"
+
+    with pytest.raises(retry.SubprocessFailed) as ei:
+        retry.checked_subprocess(
+            [sys.executable, "-c", "pass"], timeout_s=60,
+            require_stdout=True)
+    assert "empty stdout" in str(ei.value)
+
+
+def test_hang_subprocess_fault_forces_timeout(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_FAULT_INJECT", "hang_subprocess:1")
+    faults.reset_counts()
+    try:
+        with pytest.raises(retry.SubprocessFailed) as ei:
+            retry.checked_subprocess(
+                [sys.executable, "-c", "print('fast')"], timeout_s=0.5)
+        assert ei.value.kind == "timeout"
+        # budget spent: the next launch runs the real command
+        r = retry.checked_subprocess(
+            [sys.executable, "-c", "print('fast')"], timeout_s=60)
+        assert r.stdout.strip() == "fast"
+    finally:
+        faults.reset_counts()
+
+
+def test_redacted_tail_masks_credentials():
+    text = ("error: Authorization: Bearer abc.def.ghi failed\n"
+            "api_key=sk-livekeyabcdef12345 token: topsecret\n"
+            "plain diagnostic stays")
+    out = retry.redacted_tail(text, n=500)
+    for leak in ("abc.def.ghi", "livekey", "topsecret"):
+        assert leak not in out
+    assert "plain diagnostic stays" in out
+    assert retry.redacted_tail(b"bytes ok") == "bytes ok"
+    assert retry.redacted_tail("") == ""
+
+
+def test_build_timeout_env(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_BUILD_TIMEOUT", raising=False)
+    assert retry.build_timeout_s() == 300.0
+    monkeypatch.setenv("RAFT_TPU_BUILD_TIMEOUT", "42.5")
+    assert retry.build_timeout_s() == 42.5
+    monkeypatch.setenv("RAFT_TPU_BUILD_TIMEOUT", "soon")
+    with pytest.warns(UserWarning, match="RAFT_TPU_BUILD_TIMEOUT"):
+        assert retry.build_timeout_s() == 300.0
+
+
+# -------------------------------------------------------------- checkpoint
+
+
+def test_ckpt_root_env(monkeypatch, tmp_path):
+    monkeypatch.delenv("RAFT_TPU_CKPT", raising=False)
+    assert checkpoint.root() is None and not checkpoint.enabled()
+    for off in ("off", "0", "none", "false"):
+        monkeypatch.setenv("RAFT_TPU_CKPT", off)
+        assert checkpoint.root() is None
+    monkeypatch.setenv("RAFT_TPU_CKPT", str(tmp_path / "ck"))
+    assert checkpoint.root() == str(tmp_path / "ck")
+    assert checkpoint.store_for("t", (np.ones(2),), n_chunks=2) is not None
+    monkeypatch.setenv("RAFT_TPU_CKPT", "off")
+    assert checkpoint.store_for("t", (np.ones(2),), n_chunks=2) is None
+
+
+def test_chunk_store_roundtrip(tmp_path):
+    st = checkpoint.ChunkStore("k1", 3, str(tmp_path))
+    tup = (np.arange(6.0).reshape(2, 3), np.array([4, 5]))
+    st.save(0, tup)
+    st.save(1, np.float64(2.5))                  # scalar result shape
+    out = st.load(0)
+    assert isinstance(out, tuple)
+    np.testing.assert_array_equal(out[0], tup[0])
+    np.testing.assert_array_equal(out[1], tup[1])
+    assert not isinstance(st.load(1), tuple)
+    assert float(st.load(1)) == 2.5
+    assert st.load(2) is None and not st.complete()
+    st.save(2, tup)
+    assert st.complete()
+    # a fresh store object over the same directory resumes everything
+    st2 = checkpoint.ChunkStore("k1", 3, str(tmp_path))
+    assert st2.complete()
+    np.testing.assert_array_equal(st2.load(0)[0], tup[0])
+
+
+def test_chunk_store_detects_truncation_and_bitflips(tmp_path):
+    """Satellite: corrupt checkpoint artifacts are detected (content
+    hash), logged, recomputed — never crash, never serve bad data."""
+    st = checkpoint.ChunkStore("k2", 2, str(tmp_path))
+    a = np.linspace(0.0, 1.0, 32).reshape(4, 8)
+    st.save(0, (a,))
+    st.save(1, (a + 1.0,))
+    p0 = st._chunk_path(0)
+    # truncation (kill mid-rewrite, disk-full): unreadable npz
+    with open(p0, "r+b") as f:
+        f.truncate(os.path.getsize(p0) // 2)
+    with pytest.warns(UserWarning, match="unusable"):
+        assert st.load(0) is None
+    assert st.corrupt == 1
+    assert not os.path.exists(p0)                # dropped, will recompute
+    assert st.load(0) is None                    # manifest entry gone too
+    # bit-flip (silent media corruption): npz may still parse — the
+    # content hash is what catches it
+    p1 = st._chunk_path(1)
+    with open(p1, "r+b") as f:
+        f.seek(os.path.getsize(p1) - 20)
+        b = f.read(1)
+        f.seek(-1, os.SEEK_CUR)
+        f.write(bytes([b[0] ^ 0x01]))
+    with pytest.warns(UserWarning, match="unusable"):
+        assert st.load(1) is None
+    assert st.corrupt == 2 and not st.complete()
+
+
+def test_chunk_store_ignores_stale_manifest(tmp_path):
+    """A store directory left by a different chunking (or a corrupted
+    manifest) starts fresh instead of serving mismatched results."""
+    st = checkpoint.ChunkStore("k3", 2, str(tmp_path))
+    st.save(0, np.ones(3))
+    st2 = checkpoint.ChunkStore("k3", 4, str(tmp_path))   # different n_chunks
+    assert st2.load(0) is None
+    with open(os.path.join(str(tmp_path), "k3", "manifest.json"), "w") as f:
+        f.write("{not json")
+    st3 = checkpoint.ChunkStore("k3", 2, str(tmp_path))
+    assert st3.load(0) is None                   # unreadable manifest: fresh
+
+
+def test_corrupt_ckpt_fault_is_caught_by_hash(tmp_path, monkeypatch):
+    """The injected bit-rot (corrupt_ckpt:K) must be caught exactly like
+    real corruption: detected on load, dropped, recomputed."""
+    monkeypatch.setenv("RAFT_TPU_FAULT_INJECT", "corrupt_ckpt:0")
+    st = checkpoint.ChunkStore("k4", 1, str(tmp_path))
+    st.save(0, np.ones(8))
+    monkeypatch.delenv("RAFT_TPU_FAULT_INJECT")
+    with pytest.warns(UserWarning, match="unusable"):
+        assert st.load(0) is None
+    assert st.corrupt == 1
+
+
+# ------------------------------------------------- pipeline + checkpoint
+
+
+def _run_counting(ckpt, items=4):
+    from raft_tpu.parallel import pipeline
+
+    computed = []
+
+    def fn(x):
+        computed.append(float(x))
+        return jax.jit(lambda v: v * 2.0)(x)
+
+    results, stats = pipeline.run_pipelined(
+        fn, [jnp.asarray(float(k)) for k in range(items)],
+        depth=2, ckpt=ckpt)
+    return [float(np.asarray(r)) for r in results], stats, computed
+
+
+def test_pipeline_checkpoint_resume_recomputes_only_missing(tmp_path):
+    st = checkpoint.ChunkStore("pk", 4, str(tmp_path))
+    res1, stats1, computed1 = _run_counting(st)
+    assert res1 == [0.0, 2.0, 4.0, 6.0]
+    assert stats1.chunks_computed == 4 and stats1.chunks_checkpointed == 4
+    assert len(computed1) == 4
+
+    # drop chunk 2, as a kill between chunk 2's dispatch and save would
+    os.unlink(st._chunk_path(2))
+    st2 = checkpoint.ChunkStore("pk", 4, str(tmp_path))
+    st2._manifest["chunks"].pop("2")
+    res2, stats2, computed2 = _run_counting(st2)
+    assert res2 == res1                          # identical final results
+    assert computed2 == [2.0]                    # ONLY the missing chunk ran
+    assert stats2.chunks_resumed == 3 and stats2.chunks_computed == 1
+
+    # full store: nothing dispatches at all
+    st3 = checkpoint.ChunkStore("pk", 4, str(tmp_path))
+    res3, stats3, computed3 = _run_counting(st3)
+    assert res3 == res1 and computed3 == []
+    assert stats3.chunks_resumed == 4
+
+
+def test_pipeline_corrupt_chunk_recomputed_in_stream(tmp_path):
+    st = checkpoint.ChunkStore("pc", 3, str(tmp_path))
+    res1, _, _ = _run_counting(st, items=3)
+    p = st._chunk_path(1)
+    with open(p, "r+b") as f:
+        f.truncate(os.path.getsize(p) // 2)
+    st2 = checkpoint.ChunkStore("pc", 3, str(tmp_path))
+    with pytest.warns(UserWarning, match="unusable"):
+        res2, stats2, computed2 = _run_counting(st2, items=3)
+    assert res2 == res1
+    assert computed2 == [1.0]                    # corrupt chunk recomputed
+    assert stats2.ckpt_corrupt == 1 and stats2.chunks_resumed == 2
+
+
+def test_pipeline_nan_chunk_injection(monkeypatch):
+    from raft_tpu.parallel import pipeline
+
+    monkeypatch.setenv("RAFT_TPU_FAULT_INJECT", "nan_chunk:1")
+    results, stats = pipeline.run_pipelined(
+        jax.jit(lambda x: x + 1.0),
+        [jnp.asarray(float(k)) for k in range(3)], depth=2)
+    assert stats.faults_injected == 1
+    assert float(np.asarray(results[0])) == 1.0
+    assert np.isnan(np.asarray(results[1])).all()
+    assert float(np.asarray(results[2])) == 3.0
+
+
+# -------------------------------------------- sweeps: the real solve paths
+
+
+def _dlc_setup(nw=8):
+    import __graft_entry__ as ge
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+
+    design, members, rna, env, wave = ge._base(nw=nw)
+    moor = parse_mooring(
+        design["mooring"], yaw_stiffness=design["turbine"]["yaw_stiffness"])
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    return members, rna, env, wave, C_moor
+
+
+def test_degenerate_sea_states_get_quarantine_verdict_not_silent_nans():
+    """Satellite: Hs=0 and Tp=0 rows through sweep_sea_states.  Tp=0
+    makes the JONSWAP spectrum NaN — before this PR that NaN integrated
+    to an innocent 0.0 response std with no flag anywhere.  Now the lane
+    carries an explicit quarantine verdict; the Hs=0 lane (a legitimate
+    flat-calm case: zero response) stays healthy."""
+    from raft_tpu.parallel import make_wave_states, sweep_sea_states
+
+    members, rna, env, wave, C_moor = _dlc_setup()
+    cases = [[6.0, 10.0], [0.0, 10.0], [6.0, 0.0]]
+    waves = make_wave_states(np.asarray(wave.w), cases, float(env.depth))
+    out = sweep_sea_states(members, rna, env, waves, C_moor,
+                           health=True, escalate=False)
+    h = out["health"]
+    assert h["quarantined"] == [2] and h["unsalvaged"] == [2]
+    assert not out["converged"][2] and not out["finite"][2]
+    # healthy lanes untouched and verdicted
+    assert out["converged"][0] and out["converged"][1]
+    assert out["finite"][:2].all()
+    assert np.isfinite(out["std dev"][:2]).all()
+    # Hs=0 is a zero-response lane, not a failure
+    np.testing.assert_allclose(out["std dev"][1], 0.0, atol=1e-30)
+    # the bad lane's spectra stay NaN — REPORTED, never papered over
+    assert np.isnan(out["Xi_abs2"][2]).all()
+
+
+def test_ladder_salvages_iteration_starved_lanes():
+    """Lanes that fail only because the batch iteration budget is too
+    small must be rescued by the ladder's first rung (4x budget) and
+    land on the converged batch answer."""
+    from raft_tpu.parallel import make_wave_states, sweep_sea_states
+
+    members, rna, env, wave, C_moor = _dlc_setup()
+    cases = [[6.0, 10.0], [9.0, 13.0]]
+    waves = make_wave_states(np.asarray(wave.w), cases, float(env.depth))
+    ref = sweep_sea_states(members, rna, env, waves, C_moor, n_iter=25)
+    out = sweep_sea_states(members, rna, env, waves, C_moor, n_iter=2,
+                           health=True)
+    h = out["health"]
+    assert h["n_quarantined"] == 2               # n_iter=2 converges nothing
+    assert h["salvaged"] == 2 and not h["unsalvaged"]
+    assert set(h["rungs_used"]) == {"n_iter_x4"}
+    assert out["converged"].all() and out["finite"].all()
+    # salvaged lanes sit on the fixed point the full-budget batch finds
+    np.testing.assert_allclose(out["std dev"], ref["std dev"],
+                               rtol=1e-6, atol=1e-12)
+
+
+def test_health_off_is_the_exact_legacy_result():
+    """Resilience off (the default): same keys, same values — the fast
+    path must be behavior-identical to the pre-resilience sweep."""
+    from raft_tpu.parallel import make_wave_states, sweep_sea_states
+
+    members, rna, env, wave, C_moor = _dlc_setup()
+    waves = make_wave_states(np.asarray(wave.w), [[6.0, 10.0], [8.0, 12.0]],
+                             float(env.depth))
+    out = sweep_sea_states(members, rna, env, waves, C_moor)
+    assert set(out) == {"std dev", "nacelle accel std dev", "iterations",
+                        "Xi_abs2"}
+    chunked = sweep_sea_states(members, rna, env, waves, C_moor, chunk=1)
+    assert "health" not in chunked and "checkpoint" not in chunked
+    np.testing.assert_allclose(chunked["std dev"], out["std dev"],
+                               rtol=1e-12, atol=1e-14)
+
+
+def test_chunked_sweep_checkpoint_resume_parity(tmp_path, monkeypatch):
+    """The chunked DLC sweep with RAFT_TPU_CKPT armed: a second run over
+    the same program resumes every chunk from the store and returns
+    identical results (the in-process half of the kill/resume proof; the
+    cross-process half is `make resilience-smoke`)."""
+    from raft_tpu.parallel import make_wave_states, sweep_sea_states
+
+    members, rna, env, wave, C_moor = _dlc_setup()
+    waves = make_wave_states(np.asarray(wave.w), [[6.0, 10.0], [8.0, 12.0]],
+                             float(env.depth))
+    ref = sweep_sea_states(members, rna, env, waves, C_moor, chunk=1)
+
+    monkeypatch.setenv("RAFT_TPU_CKPT", str(tmp_path))
+    out1 = sweep_sea_states(members, rna, env, waves, C_moor, chunk=1)
+    assert out1["checkpoint"]["saved"] == 2
+    assert out1["pipeline"]["chunks_computed"] == 2
+    out2 = sweep_sea_states(members, rna, env, waves, C_moor, chunk=1)
+    assert out2["pipeline"]["chunks_resumed"] == 2
+    assert out2["pipeline"]["chunks_computed"] == 0
+    np.testing.assert_array_equal(out2["std dev"], out1["std dev"])
+    np.testing.assert_array_equal(out2["Xi_abs2"], out1["Xi_abs2"])
+    # and the store never changes WHAT is computed, only whether
+    np.testing.assert_allclose(out1["std dev"], ref["std dev"],
+                               rtol=1e-12, atol=1e-14)
+    # a different program (n_iter knob) lands in a different store dir:
+    # no cross-program result reuse
+    out3 = sweep_sea_states(members, rna, env, waves, C_moor, chunk=1,
+                            n_iter=10)
+    assert out3["pipeline"]["chunks_resumed"] == 0
+    # and so does a DIFFERENT DLC TABLE with identical shapes: stored
+    # results depend on input VALUES, which the abstract AOT signature
+    # alone would not distinguish
+    waves_b = make_wave_states(np.asarray(wave.w), [[5.0, 9.0], [7.0, 11.0]],
+                               float(env.depth))
+    out4 = sweep_sea_states(members, rna, env, waves_b, C_moor, chunk=1)
+    assert out4["pipeline"]["chunks_resumed"] == 0
+    assert not np.allclose(out4["std dev"], out1["std dev"])
+
+
+@pytest.mark.slow
+def test_unsalvageable_lane_walks_full_ladder():
+    """A NaN-input lane cannot be salvaged by any rung: the ladder is
+    exhausted (all four rungs attempted), the lane reported unsalvaged —
+    and the process never raises."""
+    from raft_tpu.parallel import make_wave_states, sweep_sea_states
+
+    members, rna, env, wave, C_moor = _dlc_setup()
+    waves = make_wave_states(np.asarray(wave.w), [[6.0, 10.0], [6.0, 0.0]],
+                             float(env.depth))
+    out = sweep_sea_states(members, rna, env, waves, C_moor, health=True)
+    h = out["health"]
+    assert h["quarantined"] == [1] and h["unsalvaged"] == [1]
+    assert h["rungs_used"] == {}                 # nothing claimed credit
+    assert not out["converged"][1] and out["converged"][0]
+
+
+@pytest.mark.slow
+def test_sweep_design_batch_health_and_salvage():
+    """The design-batch sweep() carries the same contract: per-lane
+    verdicts, ladder salvage of iteration-starved lanes, and identical
+    fast-path results with health off."""
+    from raft_tpu.parallel import sweep
+
+    members, rna, env, wave, C_moor = _dlc_setup()
+    thetas = jnp.asarray([1.0, 1.05])
+
+    ref = sweep(members, rna, env, wave, C_moor, thetas, n_iter=25)
+    out = sweep(members, rna, env, wave, C_moor, thetas, n_iter=2,
+                health=True)
+    h = out["health"]
+    assert h["salvaged"] == h["n_quarantined"] == 2
+    assert out["converged"].all()
+    np.testing.assert_allclose(out["std dev"], ref["std dev"],
+                               rtol=1e-6, atol=1e-12)

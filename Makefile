@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke lint
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke lint
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -18,6 +18,9 @@ pipeline-smoke:  ## fused-kernel + dispatch-ahead + donation proof (CPU, < 60 s)
 
 resilience-smoke:  ## kill/resume + NaN-quarantine + ladder-salvage proof (CPU, < 60 s)
 	python -m raft_tpu.resilience
+
+hetero-smoke:    ## shape-bucket proof: mixed OC3+VolturnUS+OC4 stream compiles
+	python -m raft_tpu.build.smoke   # once per BUCKET (< designs), cross-process
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

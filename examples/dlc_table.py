@@ -11,7 +11,8 @@ import os
 import numpy as np
 import jax.numpy as jnp
 
-from raft_tpu.build.members import build_member_set, build_rna
+from raft_tpu.build import build_bucketed_member_set
+from raft_tpu.build.members import build_rna
 from raft_tpu.core.types import Env
 from raft_tpu.model import load_design
 from raft_tpu.mooring import mooring_stiffness, parse_mooring
@@ -32,7 +33,11 @@ CASES = [
 
 def main(nw: int = 100):
     design = load_design(DESIGN)
-    members = build_member_set(design)
+    # bucketed (masked-padded) staging: the case table compiles against
+    # the design's shape CLASS, so any other design of the same class
+    # reuses the executable (raft_tpu/build/buckets.py)
+    members, sig = build_bucketed_member_set(design)
+    print(f"shape bucket: {sig.segments} segments x {sig.nodes} nodes")
     rna = build_rna(design)
     depth = float(design["mooring"]["water_depth"])
     env = Env(depth=depth)

@@ -1,51 +1,76 @@
-"""Batched design sweep: hundreds of variants in one compiled call.
+"""Mixed-design megabatch sweep: heterogeneous platforms, bucketed shapes.
 
-The reference analyzes one design per process run; here 256 OC3-spar
-diameter variants x 100 frequency bins go through the full drag-linearized
-RAO fixed point as a single jit(vmap(...)) — the pattern that scales to the
-1,000-design north-star bench (bench.py) and shards over a TPU mesh
-(raft_tpu/parallel/sweep.py).
+The reference analyzes one design per process run; the earlier form of
+this example batched diameter *variants of a single platform* (the
+geometry was a closure constant of one compiled sweep).  This one runs
+the real mixed-design path: geometry variants of FOUR different platforms
+(OC3 spar, VolturnUS-S, the two OC4 semis — different member topologies,
+different water depths, different moorings) are bucketized into a small
+ladder of padded shape classes (raft_tpu/build/buckets.py) and solved as
+ONE padded device dispatch per bucket — compile count is the number of
+buckets, not the number of designs (raft_tpu/parallel/sweep.py
+``sweep_designs``).
 """
 import os
 import time
 
 import numpy as np
-import jax.numpy as jnp
 
-from raft_tpu.build.members import build_member_set, build_rna
-from raft_tpu.core.types import Env, WaveState
-from raft_tpu.core.waves import jonswap, wave_number
 from raft_tpu.model import load_design
-from raft_tpu.mooring import mooring_stiffness, parse_mooring
-from raft_tpu.parallel import sweep
+from raft_tpu.parallel import sweep_designs
 
 HERE = os.path.dirname(os.path.abspath(__file__))
-DESIGN = os.path.join(HERE, "..", "raft_tpu", "designs", "OC3spar.yaml")
+DESIGN_DIR = os.path.join(HERE, "..", "raft_tpu", "designs")
+PLATFORMS = ["OC3spar", "VolturnUS-S", "OC4semi", "OC4semi_2"]
+
+
+def _scale_profile(v, s):
+    """Scale a YAML diameter spec (scalar / list / list of pairs) by s."""
+    if isinstance(v, (list, tuple)):
+        return [_scale_profile(x, s) for x in v]
+    return float(v) * s
+
+
+def make_variant(design: dict, scale: float) -> dict:
+    """A diameter-scaled copy of a design dict: same member topology (same
+    shape bucket), different geometry values."""
+    import copy
+
+    d = copy.deepcopy(design)
+    for mi in d["platform"]["members"]:
+        mi["d"] = _scale_profile(mi["d"], scale)
+    return d
 
 
 def main(batch: int = 256, nw: int = 100):
-    design = load_design(DESIGN)
-    members = build_member_set(design)
-    rna = build_rna(design)
-    depth = float(design["mooring"]["water_depth"])
-    env = Env(Hs=8.0, Tp=12.0, depth=depth)
-    w = jnp.asarray(np.linspace(0.05, 2.95, nw))
-    wave = WaveState(w=w, k=wave_number(w, depth),
-                     zeta=jnp.sqrt(jonswap(w, 8.0, 12.0)))
-    moor = parse_mooring(design["mooring"],
-                         yaw_stiffness=design["turbine"]["yaw_stiffness"])
-    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    bases = [load_design(os.path.join(DESIGN_DIR, p + ".yaml"))
+             for p in PLATFORMS]
+    # round-robin the platforms through a +-10% diameter-scale ladder:
+    # a heterogeneous stream, like mixed user traffic
+    labels, designs = [], []
+    for i in range(batch):
+        p = i % len(bases)
+        s = 0.9 + 0.2 * (i // len(bases)) / max(1, batch // len(bases) - 1)
+        designs.append(make_variant(bases[p], s))
+        labels.append((PLATFORMS[p], s))
 
-    scales = jnp.linspace(0.85, 1.15, batch)
     t0 = time.perf_counter()
-    out = sweep(members, rna, env, wave, C_moor, scales)
+    out = sweep_designs(designs, nw=nw, Hs=8.0, Tp=12.0,
+                        w_min=0.05, w_max=2.95, n_iter=30)
     dt = time.perf_counter() - t0
-    sig = out["std dev"]
+    bk = out["buckets"]
     print(f"{batch} designs x {nw} bins in {dt:.2f} s "
           f"(incl. compile; {batch * nw / dt:.0f} solves/s)")
+    print(f"{bk['n_designs']} mixed designs -> {bk['n_buckets']} shape "
+          f"buckets (one compiled dispatch each): "
+          + "; ".join(f"{s['designs']}x({s['segments']}seg,{s['nodes']}node,"
+                      f"{s['nw']}w)" for s in bk["signatures"]))
+    sig = out["std dev"]
     best = int(np.argmin(sig[:, 4]))
-    print(f"pitch std dev range [{sig[:, 4].min():.4f}, {sig[:, 4].max():.4f}] rad")
-    print(f"best pitch response: diameter scale {float(scales[best]):.3f} "
+    plat, s = labels[best]
+    print(f"pitch std dev range [{sig[:, 4].min():.4f}, "
+          f"{sig[:, 4].max():.4f}] rad")
+    print(f"best pitch response: {plat} at diameter scale {s:.3f} "
           f"(surge std {sig[best, 0]:.3f} m)")
     print(f"iterations per lane: max {out['iterations'].max()}")
 

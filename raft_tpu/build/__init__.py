@@ -1,2 +1,14 @@
 """Host-side preprocessing: design dicts -> device-ready pytrees."""
-from raft_tpu.build.members import build_member_set, build_rna  # noqa: F401
+from raft_tpu.build.members import (  # noqa: F401
+    build_member_set,
+    build_rna,
+    member_counts,
+)
+from raft_tpu.build.buckets import (  # noqa: F401
+    BucketSig,
+    bucketize,
+    build_bucketed_member_set,
+    ladder,
+    ladder_salt,
+    promotion_count,
+)

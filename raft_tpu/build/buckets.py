@@ -1,0 +1,222 @@
+"""Shape-bucket ladder: heterogeneous designs -> a handful of padded signatures.
+
+Every distinct design YAML (OC3, OC4, VolturnUS, user designs) has its own
+member/segment/node counts, and those counts leak into the jitted shapes —
+so a naive mixed request stream compiles one executable *per design* and
+can never share a device batch.  This module rounds each shape axis UP to
+a small ladder of size classes (masked padding does the rest): any design
+lands in one of a handful of padded signatures, compile count collapses
+from O(designs) to O(buckets), and a mixed batch of different platforms
+solves as one padded device dispatch per bucket
+(:func:`raft_tpu.parallel.sweep.sweep_designs`).
+
+Three bucketed axes:
+
+* ``segments`` / ``nodes`` — the :class:`~raft_tpu.core.types.MemberSet`
+  axes, padded through the existing masked-padding path of
+  :func:`raft_tpu.build.members.build_member_set` (``seg_mask`` /
+  ``node_mask`` gate every padded row out of statics, hydrostatics and
+  Morison sums).
+* ``nw`` — the frequency-grid length.  Padded bins extend the grid beyond
+  ``w_max`` at the same spacing with ``zeta = 0`` and a ``freq_mask`` on
+  the :class:`~raft_tpu.core.types.WaveState` that zeroes the fixed-point
+  seed at those bins, so they carry exactly-zero response through every
+  iteration and perturb neither the drag linearization's spectral moment
+  nor the convergence check (see docs/architecture.rst "Shape buckets &
+  megabatching" for the invariant argument).
+
+The default ladder is sized so the four shipped designs land in two
+buckets (OC3 spar + VolturnUS-S share the small class, the two OC4 semis
+the medium one).  ``RAFT_TPU_BUCKETS`` overrides it, e.g.::
+
+    RAFT_TPU_BUCKETS="segments=16,48,96;nodes=64,128,256;nw=32,64,128"
+
+The ladder (env-resolved, canonicalized) salts every AOT executable key a
+bucketed sweep compiles (:func:`ladder_salt`), so changing the ladder can
+never be served an executable padded for the old classes.
+"""
+from __future__ import annotations
+
+import logging
+import os
+import threading
+from typing import NamedTuple
+
+from raft_tpu.build.members import _accumulate, build_member_set, member_counts
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "RAFT_TPU_BUCKETS"
+
+DEFAULT_LADDER: dict = {
+    "segments": (16, 48, 96, 192, 384),
+    "nodes": (64, 128, 256, 512, 1024),
+    "nw": (16, 32, 64, 128, 256, 512),
+}
+
+_AXES = tuple(DEFAULT_LADDER)
+
+
+class BucketSig(NamedTuple):
+    """One padded shape class: every design whose exact counts round up to
+    the same ``BucketSig`` shares one compiled executable.  ``nw`` is None
+    when only the member axes were bucketed (no frequency grid in play)."""
+
+    segments: int
+    nodes: int
+    nw: int | None = None
+
+
+class BucketOverflow(ValueError):
+    """A design (or frequency grid) exceeds the top of the ladder on some
+    axis — extend the ladder (``RAFT_TPU_BUCKETS``) to admit it."""
+
+
+def ladder(env: str | None = None) -> dict:
+    """The active size-class ladder: ``DEFAULT_LADDER`` unless
+    ``RAFT_TPU_BUCKETS`` (or the explicit ``env`` string) overrides it.
+    Each axis is a strictly-increasing tuple of admissible padded sizes;
+    axes absent from the override keep their defaults."""
+    spec = os.environ.get(ENV_VAR, "") if env is None else env
+    spec = spec.strip()
+    out = dict(DEFAULT_LADDER)
+    if not spec:
+        return out
+    for part in spec.split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        if "=" not in part:
+            raise ValueError(
+                f"{ENV_VAR}: expected 'axis=n1,n2,...' entries separated by "
+                f"';', got {part!r}")
+        axis, _, vals = part.partition("=")
+        axis = axis.strip()
+        if axis not in _AXES:
+            raise ValueError(
+                f"{ENV_VAR}: unknown axis {axis!r}; have {sorted(_AXES)}")
+        try:
+            classes = tuple(int(v) for v in vals.split(",") if v.strip())
+        except ValueError:
+            raise ValueError(
+                f"{ENV_VAR}: non-integer class in {part!r}") from None
+        if not classes or any(c <= 0 for c in classes):
+            raise ValueError(f"{ENV_VAR}: {axis} needs positive classes")
+        if list(classes) != sorted(set(classes)):
+            raise ValueError(
+                f"{ENV_VAR}: {axis} classes must be strictly increasing")
+        out[axis] = classes
+    return out
+
+
+def ladder_salt(ld: dict | None = None) -> tuple:
+    """Canonical AOT-key component naming the active ladder version —
+    folded into every bucketed executable's key so a ladder change (env
+    override or a future default bump) invalidates instead of serving an
+    executable padded for the old classes."""
+    ld = ld or ladder()
+    return ("buckets",
+            ";".join(f"{a}={','.join(map(str, ld[a]))}" for a in _AXES))
+
+
+def round_up(value: int, axis: str, ld: dict | None = None) -> int:
+    """Smallest ladder class >= ``value`` on ``axis``; raises
+    :class:`BucketOverflow` past the ladder top."""
+    classes = (ld or ladder())[axis]
+    for c in classes:
+        if value <= c:
+            return c
+    raise BucketOverflow(
+        f"{axis}={value} exceeds the ladder top {classes[-1]}; extend "
+        f"{ENV_VAR} (e.g. {axis}=...,{classes[-1]},{2 * classes[-1]})")
+
+
+def bucketize(design: dict, nw: int | None = None, dls_max: float = 10.0,
+              include_end_b: bool = False, ld: dict | None = None) -> BucketSig:
+    """Round a design's exact (segment, node) counts — and, when given,
+    the frequency-grid length — up to their ladder classes."""
+    ld = ld or ladder()
+    S, N = member_counts(design, dls_max=dls_max, include_end_b=include_end_b)
+    return BucketSig(
+        segments=round_up(S, "segments", ld),
+        nodes=round_up(N, "nodes", ld),
+        nw=None if nw is None else round_up(int(nw), "nw", ld),
+    )
+
+
+# ---------------------------------------------------------------- promotion
+
+_lock = threading.Lock()
+_promotions = 0
+
+
+def promotion_count() -> int:
+    """Process-wide count of class promotions the self-healing build has
+    performed (a design exceeded its requested class and was bumped to the
+    next one) — surfaced in the sweep's ``buckets`` stats block so silent
+    ladder misfits are visible."""
+    return _promotions
+
+
+def _record_promotion(n: int = 1) -> None:
+    global _promotions
+    with _lock:
+        _promotions += n
+
+
+def reset_promotions() -> None:
+    """Zero the promotion counter (tests)."""
+    global _promotions
+    with _lock:
+        _promotions = 0
+
+
+def build_bucketed_member_set(design: dict, sig: BucketSig | None = None,
+                              nw: int | None = None, dls_max: float = 10.0,
+                              include_end_b: bool = False, dtype=None):
+    """Build a design's :class:`MemberSet` padded to its bucket class.
+
+    ``sig``: the target class (member axes only are used; ``sig.nw`` rides
+    along untouched).  Default: bucketize the design, rounding ``nw`` (when
+    given) into the signature too.  The member list is parsed ONCE: the
+    same accumulator measures the exact counts and feeds the padded array
+    build, so bucketing a design costs no second parse.  If the design
+    exceeds the requested class on either member axis — a caller reusing a
+    stale ``sig``, or a ladder override that shrank between staging and
+    build — the build SELF-HEALS: the failing axes are promoted to the
+    class admitting the true count (logged + counted,
+    :func:`promotion_count`) instead of raising.  Only past the ladder top
+    does it raise (:class:`BucketOverflow`).
+
+    Returns ``(members, sig)`` with ``sig`` reflecting any promotion.
+    """
+    ld = ladder()
+    acc = _accumulate(design, dls_max=dls_max, include_end_b=include_end_b)
+    S, N = len(acc.seg["l"]), len(acc.node["dls"])
+    if sig is None:
+        sig = BucketSig(
+            segments=round_up(S, "segments", ld),
+            nodes=round_up(N, "nodes", ld),
+            nw=None if nw is None else round_up(int(nw), "nw", ld),
+        )
+    if S > sig.segments or N > sig.nodes:
+        # promotion path: bump each insufficient axis to the class that
+        # admits the true count (BucketOverflow past the ladder top)
+        promoted = BucketSig(
+            segments=round_up(S, "segments", ld) if S > sig.segments
+            else sig.segments,
+            nodes=round_up(N, "nodes", ld) if N > sig.nodes else sig.nodes,
+            nw=sig.nw,
+        )
+        _record_promotion(int(promoted.segments > sig.segments)
+                          + int(promoted.nodes > sig.nodes))
+        log.info(
+            "bucket promotion: design needs (%d segments, %d nodes) > class "
+            "(%d, %d); promoted to (%d, %d) [total promotions: %d]",
+            S, N, sig.segments, sig.nodes, promoted.segments, promoted.nodes,
+            promotion_count())
+        sig = promoted
+    m = build_member_set(design, dls_max=dls_max,
+                         pad_segments=sig.segments, pad_nodes=sig.nodes,
+                         include_end_b=include_end_b, dtype=dtype, _acc=acc)
+    return m, sig

@@ -317,19 +317,12 @@ def add_member(acc: _Accum, mi: dict, member_id: int, dls_max: float = 10.0,
         acc.node["potmod"].append(bool(mi.get("potMod", False)))
 
 
-def build_member_set(design: dict, dls_max: float = 10.0,
-                     pad_segments: int | None = None, pad_nodes: int | None = None,
-                     include_end_b: bool = False, dtype=None):
-    """Build the full platform+tower :class:`MemberSet` from a design dict.
-
-    Replicates members over their ``heading`` patterns (raft/raft.py:1770-1783)
-    and appends the tower member.  ``pad_segments``/``pad_nodes`` fix the array
-    sizes (masked padding) so a family of designs shares one compiled shape.
-    """
-    import jax.numpy as jnp
-
-    from raft_tpu.core.types import MemberSet
-
+def _accumulate(design: dict, dls_max: float = 10.0,
+                include_end_b: bool = False) -> _Accum:
+    """Heading-replicated platform+tower accumulation shared by
+    :func:`build_member_set` and :func:`member_counts` — ONE parse of the
+    member list, so the size a design is *bucketed* by can never drift
+    from the size it is *built* at."""
     acc = _Accum()
     member_id = 0
     for mi in design["platform"]["members"]:
@@ -343,6 +336,40 @@ def build_member_set(design: dict, dls_max: float = 10.0,
         add_member(acc, design["turbine"]["tower"], member_id, dls_max=dls_max,
                    include_end_b=include_end_b)
         member_id += 1
+    return acc
+
+
+def member_counts(design: dict, dls_max: float = 10.0,
+                  include_end_b: bool = False) -> tuple[int, int]:
+    """Exact (segment, node) counts a design builds at — the quantity the
+    shape-bucket ladder (:mod:`raft_tpu.build.buckets`) rounds up.  Pure
+    host-side numpy, no device arrays."""
+    acc = _accumulate(design, dls_max=dls_max, include_end_b=include_end_b)
+    return len(acc.seg["l"]), len(acc.node["dls"])
+
+
+def build_member_set(design: dict, dls_max: float = 10.0,
+                     pad_segments: int | None = None, pad_nodes: int | None = None,
+                     include_end_b: bool = False, dtype=None, _acc=None):
+    """Build the full platform+tower :class:`MemberSet` from a design dict.
+
+    Replicates members over their ``heading`` patterns (raft/raft.py:1770-1783)
+    and appends the tower member.  ``pad_segments``/``pad_nodes`` fix the array
+    sizes (masked padding) so a family of designs shares one compiled shape.
+    A design that exceeds the requested padding raises ``ValueError``; the
+    shape-bucket layer (:func:`raft_tpu.build.buckets.build_bucketed_member_set`)
+    catches that and promotes the design to the next size class instead of
+    failing the caller.  ``_acc``: a prebuilt :func:`_accumulate` result for
+    THIS design (the bucket layer measures counts before building; passing
+    its accumulator avoids parsing the member list twice).
+    """
+    import jax.numpy as jnp
+
+    from raft_tpu.core.types import MemberSet
+
+    acc = (_acc if _acc is not None
+           else _accumulate(design, dls_max=dls_max,
+                            include_end_b=include_end_b))
 
     S = len(acc.seg["l"])
     N = len(acc.node["dls"])

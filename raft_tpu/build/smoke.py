@@ -1,0 +1,161 @@
+"""Hetero-smoke: prove the shape-bucket compile collapse cross-process.
+
+``python -m raft_tpu.build.smoke`` stages a MIXED design stream — OC3
+spar + VolturnUS-S + OC4 semi, three different member topologies — through
+:func:`raft_tpu.parallel.sweep.sweep_designs` in TWO fresh processes
+sharing one warm-start cache dir, and asserts:
+
+* process 1 compiles exactly ``bucket count`` executables for the mixed
+  stream (the AOT registry's own compile-event log), and that count is
+  STRICTLY below the design count — the O(designs) -> O(buckets)
+  collapse;
+* the mixed-batch (padded, bucketed) results match per-design solo
+  solves to a scale-relative 1e-5 — padding must not change the physics;
+* process 2 compiles ZERO ``sweep_designs`` executables (every bucket is
+  an AOT disk hit) and reproduces process 1's numbers bit-for-bit.
+
+Exit code 0/1; prints one JSON line.  ``make hetero-smoke`` wraps it
+(< 60 s CPU); runs in the CI fast job.
+
+``python -m raft_tpu.build.smoke child`` is the per-process payload
+(internal).
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+
+DESIGNS = ("OC3spar", "VolturnUS-S", "OC4semi")
+
+
+def _child(argv) -> None:
+    p = argparse.ArgumentParser(prog="raft_tpu.build.smoke child")
+    p.add_argument("--nw", type=int, default=24)
+    args = p.parse_args(argv)
+
+    # the smoke must never dial a hardware backend: pin CPU before jax init
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import numpy as np
+
+    from raft_tpu import cache
+    from raft_tpu.model import stage_design_base
+    from raft_tpu.parallel import forward_response, response_std, sweep_designs
+
+    cache.enable()                      # RAFT_TPU_CACHE_DIR from the parent
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fnames = [os.path.join(pkg, "designs", n + ".yaml") for n in DESIGNS]
+    kw = dict(nw=args.nw, Hs=8.0, Tp=12.0, w_min=0.05, w_max=2.95)
+
+    out = sweep_designs(fnames, n_iter=30, return_xi=False, **kw)
+    compiles = len(cache.compile_events("sweep_designs"))
+
+    # per-design solo reference (unpadded, un-bucketed) for the parity leg
+    errs = []
+    for i, fn in enumerate(fnames):
+        _, m, rna, env, wv, C = stage_design_base(fn, **kw)
+        o = forward_response(m, rna, env, wv, C, n_iter=30)
+        sig = np.asarray(response_std(o.Xi.abs2(), wv.w))
+        # scale-relative: unexcited symmetric DOFs are zero-mean float
+        # noise in both runs (see bench.hetero_buckets)
+        errs.append(float(np.max(np.abs(out["std dev"][i] - sig))
+                          / np.max(np.abs(sig))))
+
+    print(json.dumps({
+        "n_designs": len(fnames),
+        "n_buckets": out["buckets"]["n_buckets"],
+        "signatures": out["buckets"]["signatures"],
+        "promotions": out["buckets"]["promotions"],
+        "compiles": compiles,
+        "aot": cache.report().get("aot", {}),
+        "solo_max_rel": max(errs),
+        "sigma": np.asarray(out["std dev"]).tolist(),
+    }))
+
+
+def _run_child(cache_dir: str, nw: int) -> dict:
+    env = dict(os.environ)
+    env["RAFT_TPU_CACHE_DIR"] = cache_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    # deterministic whatever environment launches it (cache-smoke precedent):
+    # a caller's virtual-device mesh would change topology and the AOT keys
+    env.pop("XLA_FLAGS", None)
+    env.pop("RAFT_TPU_BUCKETS", None)   # the claim is about the default ladder
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.build.smoke", "child",
+         "--nw", str(nw)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+    )
+    if r.returncode != 0:
+        raise SystemExit(
+            f"hetero-smoke child failed (rc={r.returncode}):\n"
+            + (r.stderr or r.stdout)[-2000:]
+        )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def smoke(argv) -> int:
+    p = argparse.ArgumentParser(prog="raft_tpu.build.smoke")
+    p.add_argument("--nw", type=int, default=24, help="frequency bins")
+    p.add_argument("--dir", default=None,
+                   help="cache dir (default: fresh temp dir, removed after)")
+    args = p.parse_args(argv)
+
+    d = args.dir or tempfile.mkdtemp(prefix="raft_tpu_hetero_smoke_")
+    try:
+        cold = _run_child(d, args.nw)
+        warm = _run_child(d, args.nw)
+        checks = {
+            # one compile per bucket, strictly fewer than designs
+            "cold_compiles_eq_buckets":
+                cold["compiles"] == cold["n_buckets"],
+            "fewer_compiles_than_designs":
+                cold["compiles"] < cold["n_designs"],
+            # padding must not change the physics
+            "solo_parity_1e5": cold["solo_max_rel"] <= 1e-5,
+            # a warm process recompiles NOTHING for the mixed stream
+            "warm_zero_compiles": warm["compiles"] == 0,
+            "warm_disk_hits": warm["aot"].get("disk_hits", 0)
+                              >= cold["n_buckets"],
+            "results_identical": warm["sigma"] == cold["sigma"],
+        }
+        ok = all(checks.values())
+        print(json.dumps({
+            "ok": ok,
+            **checks,
+            "n_designs": cold["n_designs"],
+            "n_buckets": cold["n_buckets"],
+            "signatures": cold["signatures"],
+            "cold_compiles": cold["compiles"],
+            "warm_compiles": warm["compiles"],
+            "warm_aot": warm["aot"],
+            "solo_max_rel": cold["solo_max_rel"],
+            "cache_dir": d,
+        }))
+        return 0 if ok else 1
+    finally:
+        if args.dir is None:
+            shutil.rmtree(d, ignore_errors=True)
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if argv and argv[0] == "child":
+        _child(argv[1:])
+        return 0
+    return smoke(argv)
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

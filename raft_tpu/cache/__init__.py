@@ -33,6 +33,7 @@ from raft_tpu.cache.aot import (  # noqa: F401
     cached_callable,
     cached_compile,
     callable_salt,
+    compile_events,
     donation_salt,
 )
 from raft_tpu.cache.staging import FileKey, cached_arrays, staging_key  # noqa: F401

@@ -50,6 +50,20 @@ from raft_tpu.cache.staging import _update
 
 _mem: dict = {}
 
+# tags of executables that were ACTUALLY lowered+compiled in this process
+# (every reuse layer missed) — the evidence stream behind compile-count
+# claims like "a mixed design stream compiles once per shape bucket":
+# bench.py's buckets block and `make hetero-smoke` read it
+_compile_events: list = []
+
+
+def compile_events(tag: str | None = None) -> list:
+    """Tags compiled (not served from any warm layer) in this process, in
+    order; filtered to one ``tag`` when given."""
+    if tag is None:
+        return list(_compile_events)
+    return [t for t in _compile_events if t == tag]
+
 
 def _version_salts() -> tuple:
     import jax
@@ -326,6 +340,7 @@ def cached_compile(tag: str, fn, args, *, consts=(), mesh=None,
         compiled = jax.jit(fn, **kw).lower(*args).compile()
     cold_s = time.perf_counter() - t0
     stats.record("aot", "miss")
+    _compile_events.append(tag)
     _try_store(key, compiled, cold_s)
     _mem[key] = compiled
     return compiled
@@ -352,3 +367,4 @@ def cached_callable(tag: str, fn, args, *, consts=(), mesh=None,
 def clear_memory() -> None:
     """Drop the in-process memo (tests)."""
     _mem.clear()
+    _compile_events.clear()

@@ -210,3 +210,11 @@ class WaveState:
     # vary heading alongside (Hs, Tp) (reference env surface carries beta,
     # raft/runRAFT.py:68).
     beta: Optional[Array] = struct.field(default=None)
+    # (nw,) bool: True = physical frequency bin, False = bucket padding
+    # (raft_tpu.build.buckets): padded bins extend the grid past w_max
+    # with zeta = 0 AND a zeroed fixed-point seed (solve_dynamics), which
+    # together pin their response to exactly zero every iteration — the
+    # invariant that makes a padded grid's solution bit-for-bit the
+    # unpadded one (up to reduction order).  None (the default) means
+    # every bin is physical: the pre-bucketing program, untouched.
+    freq_mask: Optional[Array] = struct.field(default=None)

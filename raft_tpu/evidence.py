@@ -122,6 +122,12 @@ def main():
               .get("north_star_volturn_bem", {}).get("resilience"))
         if rb is not None:
             bench["resilience"] = rb
+        # shape-bucket megabatch proof (compile count <= bucket count for
+        # a mixed design stream, padded-lane parity vs solo solves): the
+        # O(designs)->O(buckets) claim must be one key deep too
+        bb = bench_json.get("workloads", {}).get("hetero_buckets")
+        if bb is not None:
+            bench["buckets"] = bb
     else:
         bench["ok"] = False
         bench["error"] = "no JSON line found on bench stdout"

@@ -188,6 +188,66 @@ def _entry_fused_rao_solve():
     return fn, mk(0), mk(1)
 
 
+def _entry_sweep_designs():
+    """Traced core of :func:`raft_tpu.parallel.sweep.sweep_designs` — the
+    shape-bucketed mixed-design megabatch: the per-design arrays (members,
+    RNA, env, wave, mooring) are batch-leading vmapped INPUTS, so one
+    executable serves every design of a bucket class.  The two argument
+    pytrees stack TWO DIFFERENT designs (OC3 spar + a station-split
+    variant with different exact segment/node counts) padded to ONE
+    bucket, in swapped lane order — the zero-retrace budget is exactly
+    the "two different same-bucket designs never recompile" claim."""
+    import copy
+
+    import jax
+    import numpy as np
+
+    key = ("sweep_designs", bool(jax.config.jax_enable_x64))
+    hit = _base_cache.get(key)
+    if hit is None:
+        from raft_tpu.model import load_design, stage_designs
+        from raft_tpu.build import buckets as _buckets
+
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        path = os.path.join(pkg, "designs", "OC3spar.yaml")
+        variant = copy.deepcopy(load_design(path))
+        # a genuinely different topology in the same bucket: split the
+        # spar's station list (more segments/nodes than stock OC3)
+        m0 = variant["platform"]["members"][0]
+        s0, s1 = float(m0["stations"][0]), float(m0["stations"][-1])
+        m0["stations"] = [s0, 0.5 * (s0 + s1), s1]
+        m0["d"] = [float(np.atleast_1d(m0["d"])[0])] * 3
+        t0 = float(np.atleast_1d(m0["t"])[0])
+        m0["t"] = [t0] * 3
+        staged = stage_designs([path, variant], nw=6, Hs=6.0, Tp=10.0,
+                               w_min=0.3, w_max=2.1)
+        if len(staged) != 1:
+            raise AssertionError(
+                f"audit fixture designs landed in {len(staged)} buckets "
+                f"({list(staged)}); they must share one")
+        (batch,) = staged.values()
+        sig = _buckets.bucketize(load_design(path), nw=6)
+        sig_v = _buckets.bucketize(variant, nw=6)
+        if sig != sig_v:
+            raise AssertionError(f"fixture buckets diverged: {sig} vs {sig_v}")
+        hit = _base_cache[key] = batch
+    batch = hit
+
+    from raft_tpu.parallel.sweep import forward_response
+
+    def one(members, rna, env, wave, C_moor):
+        out = forward_response(members, rna, env, wave, C_moor,
+                               n_iter=_N_ITER, method="scan")
+        return out.Xi.abs2(), out.n_iter
+
+    fn = jax.vmap(one)
+    args = (batch.members, batch.rna, batch.env, batch.wave, batch.C_moor)
+    # the SAME two designs in swapped lane order: identical structure and
+    # shapes, different values — one trace must serve both
+    args2 = jax.tree_util.tree_map(lambda a: a[::-1], args)
+    return fn, args, args2
+
+
 def _entry_eigen():
     """Traced core of :func:`raft_tpu.solve.eigen.solve_eigen` — the
     generalized symmetric eigensolve (Cholesky + Jacobi sweeps)."""
@@ -224,6 +284,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("fused_rao_solve",
                "raft_tpu.core.pallas6.solve_rao_pallas",
                _entry_fused_rao_solve),
+    EntryPoint("sweep_designs", "raft_tpu.parallel.sweep.sweep_designs",
+               _entry_sweep_designs),
 )
 
 

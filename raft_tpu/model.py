@@ -11,6 +11,8 @@ API in :mod:`raft_tpu.parallel`.
 """
 from __future__ import annotations
 
+import dataclasses as _dataclasses
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -717,16 +719,51 @@ def interp_heading_excitation(betas, F_all, beta: float) -> np.ndarray:
     return (1.0 - t) * np.asarray(F_all[j - 1]) + t * np.asarray(F_all[j])
 
 
-def load_design(fname: str) -> dict:
+def load_design(fname) -> dict:
+    """Parse a design YAML path — or pass a dict through unchanged, so
+    every staging entry point accepts in-memory design variants (e.g.
+    programmatically perturbed geometries) alongside files."""
+    if isinstance(fname, dict):
+        return fname
     import yaml
 
     with open(fname) as f:
         return yaml.safe_load(f)
 
 
-def stage_design_base(fname: str, nw: int, Hs: float, Tp: float,
+def _staged_wave(nw: int, w_min: float, w_max: float, depth: float,
+                 Hs: float, Tp: float, nw_pad: int | None = None) -> WaveState:
+    """The ONE staged-grid recipe shared by :func:`stage_design_base` and
+    :func:`stage_designs`: ``nw`` JONSWAP bins on [w_min, w_max], plus —
+    when ``nw_pad`` exceeds ``nw`` — bucket padding that extends the grid
+    past ``w_max`` at the same spacing with ``zeta = 0`` and a
+    ``freq_mask`` marking the physical bins (the padded bins then carry
+    exactly-zero response through the solve; see
+    :mod:`raft_tpu.build.buckets`)."""
+    nw = int(nw)
+    nw_p = nw if nw_pad is None else int(nw_pad)
+    if nw_p < nw:
+        raise ValueError(f"nw_pad={nw_p} smaller than nw={nw}")
+    if nw_p > nw and nw < 2:
+        raise ValueError("frequency padding needs nw >= 2 to fix the spacing")
+    w_host = np.linspace(w_min, w_max, nw)
+    if nw_p > nw:
+        dw = w_host[1] - w_host[0]
+        w_host = np.concatenate(
+            [w_host, w_host[-1] + dw * np.arange(1, nw_p - nw + 1)])
+    w = jnp.asarray(w_host)
+    zeta = jnp.sqrt(jonswap(w, Hs, Tp))
+    mask = None
+    if nw_p > nw:
+        mask = jnp.asarray(np.arange(nw_p) < nw)
+        zeta = zeta * mask                    # exact zeros at padded bins
+    return WaveState(w=w, k=wave_number(w, depth), zeta=zeta,
+                     freq_mask=mask)
+
+
+def stage_design_base(fname, nw: int, Hs: float, Tp: float,
                       w_min: float, w_max: float,
-                      with_mooring: bool = True):
+                      with_mooring: bool = True, bucket=None):
     """One-call staging of a design to the forward-pipeline inputs:
     ``(design, members, rna, env, wave, C_moor)``.
 
@@ -739,22 +776,176 @@ def stage_design_base(fname: str, nw: int, Hs: float, Tp: float,
     solve (``C_moor`` is then None): the stiffness is a jitted
     forward-mode Jacobian through the catenary Newton solve, so call
     sites that bring their own mooring must not pay its compile.
+
+    ``bucket``: ``None`` (default) builds the design at its exact shapes —
+    the historical behavior, byte-identical.  ``True`` rounds the member
+    axes and the frequency grid up to their shape-bucket classes
+    (:func:`raft_tpu.build.buckets.bucketize`), and an explicit
+    :class:`~raft_tpu.build.buckets.BucketSig` pins the class directly
+    (self-healing promotion applies if the design outgrows it) — every
+    design staged at one class shares one compiled shape.
     """
     design = load_design(fname)
-    members = build_member_set(design)
+    members, _sig, rna, env, wave, C_moor = _stage_design_one(
+        design, nw, Hs, Tp, w_min, w_max, with_mooring, bucket)
+    return design, members, rna, env, wave, C_moor
+
+
+def _stage_design_one(design: dict, nw: int, Hs: float, Tp: float,
+                      w_min: float, w_max: float, with_mooring: bool,
+                      bucket):
+    """The ONE per-design staging recipe shared by
+    :func:`stage_design_base` and :func:`stage_designs`: member build
+    (exact or bucket-padded), RNA, per-design-depth Env, (padded) wave
+    grid, mooring stiffness — one body, so a solo-staged design and the
+    same design staged inside a megabatch cannot drift.  ``bucket``:
+    ``None`` exact shapes, ``True`` bucketize, or an explicit
+    :class:`~raft_tpu.build.buckets.BucketSig`.  Returns
+    ``(members, sig_or_None, rna, env, wave, C_moor)``."""
+    nw_pad = None
+    sig = None
+    if bucket is None:
+        members = build_member_set(design)
+    else:
+        from raft_tpu.build import buckets as _buckets
+
+        if isinstance(bucket, _buckets.BucketSig):
+            members, sig = _buckets.build_bucketed_member_set(design, bucket)
+        else:
+            members, sig = _buckets.build_bucketed_member_set(design, nw=nw)
+        nw_pad = sig.nw
     rna = build_rna(design)
     depth = float(design["mooring"]["water_depth"])
     env = Env(Hs=Hs, Tp=Tp, depth=depth)
-    w = jnp.asarray(np.linspace(w_min, w_max, nw))
-    wave = WaveState(w=w, k=wave_number(w, depth),
-                     zeta=jnp.sqrt(jonswap(w, Hs, Tp)))
+    wave = _staged_wave(nw, w_min, w_max, depth, Hs, Tp, nw_pad=nw_pad)
     C_moor = None
     if with_mooring:
         moor = parse_mooring(
             design["mooring"],
             yaw_stiffness=design["turbine"]["yaw_stiffness"])
         C_moor = mooring_stiffness(moor, jnp.zeros(6))
-    return design, members, rna, env, wave, C_moor
+    return members, sig, rna, env, wave, C_moor
+
+
+@_dataclasses.dataclass
+class DesignBatch:
+    """One shape bucket's worth of staged designs, stacked batch-leading.
+
+    Every array pytree carries a leading lane axis of length
+    ``len(fnames)``; a whole batch solves as ONE padded device dispatch
+    (:func:`raft_tpu.parallel.sweep.sweep_designs`).  ``indices`` maps
+    lanes back to positions in the caller's original design list.
+    """
+
+    sig: "object"            # raft_tpu.build.buckets.BucketSig
+    fnames: list             # per-lane design identifiers (path or dict)
+    indices: list            # per-lane position in the caller's list
+    members: "object"        # MemberSet, (B, ...) stacked
+    rna: "object"            # RNA, (B,) stacked scalars
+    env: "object"            # Env, (B,) stacked scalars
+    wave: "object"           # WaveState, (B, nw_pad)
+    C_moor: "object"         # (B, 6, 6) or None (with_mooring=False)
+    bem: "object" = None     # staged (A[B,nw,6,6], B[...], F Cx[B,nw,6]) or None
+    nw: int = 0              # physical (unpadded) frequency-bin count
+    promotions: int = 0      # class promotions THIS batch's staging performed
+
+
+def _stack_trees(trees):
+    """Stack a list of identical-structure pytrees batch-leading."""
+    import jax
+
+    return jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *trees)
+
+
+def stage_designs(fnames, nw: int, Hs: float, Tp: float,
+                  w_min: float, w_max: float, with_mooring: bool = True,
+                  bems=None) -> dict:
+    """Stage a MIXED design list into shape buckets, stacked batch-leading.
+
+    Each design (YAML path or dict) is bucketized
+    (:func:`raft_tpu.build.buckets.bucketize`, honoring
+    ``RAFT_TPU_BUCKETS``), built padded to its class (self-healing
+    promotion included), staged with the shared
+    :func:`stage_design_base` recipe — per-design water depth, mooring
+    stiffness, padded frequency grid — and grouped by
+    :class:`~raft_tpu.build.buckets.BucketSig`: the result maps each
+    signature to a :class:`DesignBatch` whose members/RNA/env/wave/mooring
+    (and optional BEM layouts) are stacked along a leading lane axis.
+    One executable per bucket then serves ANY designs of that class —
+    the designs are call *arguments*, not closure constants.
+
+    ``bems``: optional per-design raw BEM tuples (``A[6,6,nw]``,
+    ``B[6,6,nw]``, ``F[6,nw]`` complex, on the physical grid) — all
+    designs or none (a bucket mixing BEM and strip-only lanes would need
+    two programs).  Staged frequency-leading, zero-padded on the bucket
+    grid, excitation zeta-scaled (zero at padded bins by construction).
+    """
+    from raft_tpu.build import buckets as _buckets
+
+    fnames = list(fnames)
+    if bems is not None:
+        bems = list(bems)
+        if len(bems) != len(fnames):
+            raise ValueError(f"bems has {len(bems)} entries for "
+                             f"{len(fnames)} designs")
+        if any(b is None for b in bems):
+            raise ValueError("bems must cover every design or be None: a "
+                             "bucket mixing BEM and strip-only lanes would "
+                             "need two different compiled programs")
+    staged: dict = {}
+    promo: dict = {}
+    for i, fn in enumerate(fnames):
+        design = load_design(fn)
+        p0 = _buckets.promotion_count()
+        members, sig, rna, env, wave, C_moor = _stage_design_one(
+            design, nw, Hs, Tp, w_min, w_max, with_mooring, bucket=True)
+        bem = None
+        if bems is not None:
+            bem = _stage_bem_padded(bems[i], wave, nw)
+        staged.setdefault(sig, []).append(
+            (i, fn, members, rna, env, wave, C_moor, bem))
+        promo[sig] = promo.get(sig, 0) + (_buckets.promotion_count() - p0)
+
+    out: dict = {}
+    for sig, rows in staged.items():
+        idx, names, ms, rnas, envs, waves, cms, bs = zip(*rows)
+        out[sig] = DesignBatch(
+            sig=sig,
+            fnames=list(names),
+            indices=list(idx),
+            members=_stack_trees(ms),
+            rna=_stack_trees(rnas),
+            env=_stack_trees(envs),
+            wave=_stack_trees(waves),
+            C_moor=None if cms[0] is None else jnp.stack(cms),
+            bem=None if bs[0] is None else _stack_trees(bs),
+            nw=int(nw),
+            promotions=promo[sig],
+        )
+    return out
+
+
+def _stage_bem_padded(bem, wave: WaveState, nw: int):
+    """One design's raw host BEM tuple -> the bucket grid's staged device
+    layout.  Padding is the ONLY step owned here: the host arrays are
+    zero-padded past the physical bins, then routed through the shared
+    device-layout + zeta-scaling recipe behind :func:`raft_tpu.parallel.
+    sweep.stage_bem` — one convention, so a bucketed BEM lane cannot
+    drift from a solo ``stage_bem`` staging.  Padded-bin excitation is
+    exactly zero by construction (zeta is zero there)."""
+    from raft_tpu.parallel.sweep import _bem_device_layout, _stage_zeta
+
+    A_h, B_h, F_h = (np.asarray(x) for x in bem)   # (6,6,nw)/(6,6,nw)/(6,nw)
+    if A_h.shape[-1] != nw:
+        raise ValueError(f"BEM arrays carry {A_h.shape[-1]} frequency bins; "
+                         f"the staged grid has {nw} physical bins")
+    nw_p = int(wave.w.shape[-1])
+    if nw_p > nw:
+        tail = ((0, 0),) * (A_h.ndim - 1) + ((0, nw_p - nw),)
+        A_h = np.pad(A_h, tail)
+        B_h = np.pad(B_h, tail)
+        F_h = np.pad(F_h, ((0, 0), (0, nw_p - nw)))
+    return _stage_zeta(_bem_device_layout((A_h, B_h, F_h)), wave.zeta)
 
 
 def run_raft(fname_design: str, fname_env: str | None = None,

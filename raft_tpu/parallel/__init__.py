@@ -36,5 +36,6 @@ from raft_tpu.parallel.sweep import (  # noqa: F401
     spread_sea_state,
     stage_bem,
     sweep,
+    sweep_designs,
     sweep_sea_states,
 )

@@ -1137,6 +1137,287 @@ def sweep(
         std_from=("Xi_abs2", wave.w) if return_xi else None)
 
 
+def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
+                          chunk, pipeline_depth):
+    """Solve ONE shape bucket's stacked design batch as one padded device
+    dispatch: the per-design arrays (members, RNA, env, wave, mooring,
+    optional BEM) are batch-leading vmapped INPUTS — not closure
+    constants like :func:`sweep` — so the compiled executable is
+    design-agnostic: any mix of designs in this bucket class (and batch
+    size) reuses it, in-process and through the AOT registry."""
+    from raft_tpu import cache as _cache
+    from raft_tpu.build import buckets as _buckets
+
+    B = len(batch.fnames)
+    has_bem = batch.bem is not None
+    dtype = batch.members.seg_l.dtype
+    C_moor = (batch.C_moor if batch.C_moor is not None
+              else jnp.zeros((B, 6, 6), dtype=dtype))
+
+    def one(members, rna, env, wave, C_moor_i, bem, *, _n=n_iter,
+            _relax=0.8, _tik=0.0):
+        out = forward_response(members, rna, env, wave, C_moor_i,
+                               bem=bem if has_bem else None,
+                               n_iter=_n, relax=_relax, tik=_tik)
+        abs2 = out.Xi.abs2()
+        stat = abs2 if return_xi else response_std(abs2, wave.w)
+        if health:
+            return stat, out.n_iter, out.converged, jnp.isfinite(abs2).all()
+        return stat, out.n_iter
+
+    bem_arg = batch.bem if has_bem else jnp.zeros((), dtype=dtype)
+    bem_ax = 0 if has_bem else None
+    args = (batch.members, batch.rna, batch.env, batch.wave, C_moor, bem_arg)
+    in_axes = (0, 0, 0, 0, 0, bem_ax)
+    extra = ("n_iter", n_iter, "return_xi", bool(return_xi),
+             "health", bool(health), "has_bem", has_bem,
+             *_buckets.ladder_salt())
+    pipe_stats = None
+    if chunk is not None:
+        from raft_tpu.parallel import pipeline as _pipe
+
+        # bucket sizes are EMERGENT from the design mix, so the caller
+        # cannot pick a chunk that divides every bucket: clamp to the
+        # largest divisor of this bucket's lane count not exceeding the
+        # request (worst case 1 = lane-by-lane; chunking is a pipelining
+        # optimization, never a correctness constraint)
+        chunk = max(d for d in range(1, min(int(chunk), B) + 1)
+                    if B % d == 0)
+
+        def stage(k):
+            sl = slice(k * chunk, (k + 1) * chunk)
+            lanes = jax.tree_util.tree_map(lambda a: a[sl], args[:5])
+            # the BEM batch rides the mapped axis too — slice it with the
+            # lanes (the dummy scalar is broadcast via in_axes=None)
+            b = (jax.tree_util.tree_map(lambda a: a[sl], batch.bem)
+                 if has_bem else bem_arg)
+            return (*lanes, b)
+
+        staged0 = stage(0)
+        fn = _cache.cached_callable(
+            "sweep_designs", jax.vmap(one, in_axes=in_axes), staged0,
+            extra=(*extra, "chunk", chunk))
+        # durable chunk store (RAFT_TPU_CKPT): the executable's key hashes
+        # the designs ABSTRACTLY (they are call arguments), but stored
+        # RESULTS depend on their values — fold a content hash of every
+        # staged batch array into the store key, or a resume would serve
+        # design set A's responses for a same-shaped design set B.  The
+        # hash forces a host materialization of the whole stacked batch,
+        # so it only runs when the store is actually armed.
+        from raft_tpu.resilience import checkpoint as _ckpt
+
+        store = None
+        if _ckpt.enabled():
+            data_leaves = jax.tree_util.tree_flatten(
+                (args[:5], batch.bem if has_bem else ()))[0]
+            store = _ckpt.store_for(
+                "sweep_designs", staged0,
+                extra=(*extra, "chunk", chunk,
+                       "data_sha", _ckpt.content_hash(data_leaves)),
+                n_chunks=B // chunk)
+        results, pipe_stats = _pipe.run_pipelined(
+            fn, range(B // chunk), depth=pipeline_depth,
+            stage=lambda k: staged0 if k == 0 else stage(k),
+            ckpt=store)
+        outs = tuple(np.concatenate([np.atleast_1d(r[j]) for r in results])
+                     for j in range(len(results[0])))
+    else:
+        fn = _cache.cached_callable(
+            "sweep_designs", jax.vmap(one, in_axes=in_axes), args,
+            extra=extra)
+        outs = fn(*args)
+    out0, iters = outs[:2]
+    if return_xi:
+        res = {
+            "std dev": np.asarray(response_std(jnp.asarray(out0),
+                                               batch.wave.w[0])),
+            "iterations": np.asarray(iters),
+            "Xi_abs2": np.asarray(out0),
+        }
+    else:
+        res = {"std dev": np.asarray(out0), "iterations": np.asarray(iters)}
+    if pipe_stats is not None:
+        res["pipeline"] = pipe_stats.to_dict()
+        if store is not None:
+            res["checkpoint"] = store.to_dict()
+    if not health:
+        return res
+
+    rung_fns: dict = {}   # one executable per rung even with cache off
+
+    def solve_lane(idx, n_iter_r, relax_r, tik_r):
+        lane = jax.tree_util.tree_map(lambda a: a[idx], args[:5])
+        lane_bem = (jax.tree_util.tree_map(lambda a: a[idx], batch.bem)
+                    if has_bem else bem_arg)
+        fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
+        if fn1 is None:
+            # the rung re-traces `one` (the batch body) with the rung's
+            # knobs, so a salvage solve cannot drift from the batch solve
+            def g(m_i, r_i, e_i, w_i, c_i, b_i, _n=n_iter_r, _r=relax_r,
+                  _t=tik_r):
+                return one(m_i, r_i, e_i, w_i, c_i, b_i,
+                           _n=_n, _relax=_r, _tik=_t)
+
+            fn1 = _cache.cached_callable(
+                "resilience.ladder.designs", g, (*lane, lane_bem),
+                extra=(*extra, "rung_n", n_iter_r, "relax", relax_r,
+                       "tik", tik_r))
+            rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
+        stat, it, conv_i, fin_i = fn1(*lane, lane_bem)
+        return ((np.asarray(stat), np.asarray(it)),
+                bool(np.asarray(conv_i)), bool(np.asarray(fin_i)),
+                int(np.asarray(it)))
+
+    return _health_finish(
+        res, outs[2], outs[3],
+        ["Xi_abs2", "iterations"] if return_xi else ["std dev", "iterations"],
+        solve_lane, n_iter, escalate,
+        std_from=("Xi_abs2", batch.wave.w[0]) if return_xi else None)
+
+
+def sweep_designs(
+    fnames=None,
+    nw: int = 100,
+    Hs: float = 8.0,
+    Tp: float = 12.0,
+    w_min: float = 0.05,
+    w_max: float = 2.95,
+    with_mooring: bool = True,
+    bems=None,
+    staged: dict | None = None,
+    n_iter: int = 25,
+    return_xi: bool = True,
+    health: bool = False,
+    escalate: bool = True,
+    chunk: int | None = None,
+    pipeline_depth: int | None = None,
+):
+    """Solve a MIXED batch of different platform designs — one padded
+    device dispatch per shape bucket.
+
+    Where :func:`sweep` vmaps parameter variations of ONE staged design
+    (the geometry is a closure constant baked into the executable), this
+    lifts the per-design arrays into batch-leading vmapped inputs: the
+    designs (YAML paths or dicts) are bucketized into a small ladder of
+    padded shape classes (:mod:`raft_tpu.build.buckets`, override via
+    ``RAFT_TPU_BUCKETS``), staged batch-leading per bucket
+    (:func:`raft_tpu.model.stage_designs` — per-design water depth,
+    mooring stiffness, masked member padding, zero-response frequency
+    padding), and each bucket solves as ONE compiled call.  Compile count
+    is O(buckets), not O(designs): a request stream mixing OC3, OC4,
+    VolturnUS and arbitrary user designs reuses a handful of executables
+    (the AOT registry key carries the ladder version, so every warm
+    process shares them too).
+
+    ``staged``: pass a prebuilt :func:`raft_tpu.model.stage_designs`
+    result (the ``fnames``/``nw``/sea-state arguments are then ignored
+    for staging).  ``bems``: optional per-design raw BEM tuples, staged
+    padded (see ``stage_designs``).  ``chunk``: split each bucket's lane
+    axis into ``chunk``-sized sub-batches executed through the
+    dispatch-ahead pipeline (:mod:`raft_tpu.parallel.pipeline`).
+    ``health=True``: the resilience contract per lane — a bad design's
+    lane is quarantined and ladder-salvaged without touching its
+    bucket-mates (see :func:`sweep_sea_states`).
+
+    Returns a dict in the ORIGINAL design order: ``"std dev"`` (D, 6),
+    ``"iterations"`` (D,), ``"Xi_abs2"`` (D, nw, 6) trimmed to the
+    physical bins (``return_xi=True``), a ``"buckets"`` stats block
+    (ladder, signatures, lane counts, promotions), plus the per-lane
+    ``"converged"``/``"finite"``/``"health"`` verdicts when ``health``.
+    """
+    from raft_tpu.build import buckets as _buckets
+    from raft_tpu.model import stage_designs
+
+    if staged is None:
+        if fnames is None:
+            raise ValueError("sweep_designs needs a design list (fnames) "
+                             "or a prebuilt staged= dict")
+        staged = stage_designs(fnames, nw=nw, Hs=Hs, Tp=Tp, w_min=w_min,
+                               w_max=w_max, with_mooring=with_mooring,
+                               bems=bems)
+    elif bems is not None:
+        raise ValueError(
+            "bems cannot be applied to a prebuilt staged= dict (staging "
+            "already fixed each batch's BEM layout): pass bems to "
+            "stage_designs (or to sweep_designs with fnames)")
+    batches = list(staged.values())
+    if not batches:
+        raise ValueError("no designs staged")
+    D = sum(len(b.fnames) for b in batches)
+    nw_phys = batches[0].nw
+
+    per_bucket = [
+        _sweep_designs_bucket(b, n_iter, return_xi, health, escalate,
+                              chunk, pipeline_depth)
+        for b in batches
+    ]
+
+    def scatter(key, trim_nw=False):
+        first = per_bucket[0][key]
+        out = np.zeros((D,) + first.shape[1:], dtype=first.dtype)
+        for b, res in zip(batches, per_bucket):
+            out[np.asarray(b.indices)] = res[key]
+        if trim_nw and out.ndim >= 3:
+            out = out[:, :nw_phys]
+        return out
+
+    # report lanes in the caller's original order, like every array
+    names = [None] * D
+    for b in batches:
+        for i, fn in zip(b.indices, b.fnames):
+            names[i] = fn
+    result = {
+        "designs": names,
+        "std dev": scatter("std dev"),
+        "iterations": scatter("iterations"),
+    }
+    if return_xi:
+        result["Xi_abs2"] = scatter("Xi_abs2", trim_nw=True)
+    result["buckets"] = {
+        "ladder": _buckets.ladder_salt()[1],
+        "n_designs": D,
+        "n_buckets": len(batches),
+        "signatures": [
+            {"segments": b.sig.segments, "nodes": b.sig.nodes,
+             "nw": b.sig.nw, "designs": len(b.fnames)}
+            for b in batches
+        ],
+        # promotions THIS staging performed (per-batch deltas recorded by
+        # stage_designs), not the process-wide counter — a sweep must not
+        # inherit earlier calls' ladder misfits
+        "promotions": sum(getattr(b, "promotions", 0) for b in batches),
+    }
+    for key in ("pipeline", "checkpoint"):
+        blocks = {str(tuple(b.sig)): res[key]
+                  for b, res in zip(batches, per_bucket) if key in res}
+        if blocks:
+            result[key] = blocks
+    if health:
+        result["converged"] = scatter("converged")
+        result["finite"] = scatter("finite")
+        merged_rungs: dict = {}
+        quarantined, unsalvaged, salvaged = [], [], 0
+        for b, res in zip(batches, per_bucket):
+            h = res["health"]
+            idx = list(b.indices)
+            quarantined += [idx[i] for i in h["quarantined"]]
+            unsalvaged += [idx[i] for i in h["unsalvaged"]]
+            salvaged += h["salvaged"]
+            for r, n in h["rungs_used"].items():
+                merged_rungs[r] = merged_rungs.get(r, 0) + n
+        result["health"] = {
+            "lanes": D,
+            "n_quarantined": len(quarantined),
+            "quarantined": sorted(quarantined),
+            "salvaged": salvaged,
+            "unsalvaged": sorted(unsalvaged),
+            "rungs_used": merged_rungs,
+            "per_bucket": {str(tuple(b.sig)): res["health"]
+                           for b, res in zip(batches, per_bucket)},
+        }
+    return result
+
+
 def grad_response_std(
     members: MemberSet,
     rna: RNA,

@@ -205,6 +205,16 @@ def _solve_dynamics_impl(
     dtype = lin.C.dtype
 
     Xi0 = Cx(jnp.full((nw, 6), 0.1, dtype=dtype), jnp.zeros((nw, 6), dtype=dtype))
+    if wave.freq_mask is not None:
+        # bucket-padded bins (freq_mask False) start at exactly zero: with
+        # zeta = 0 there (zero excitation) a zero iterate is a fixed point
+        # of the padded bin — F_drag and the vRMS spectral moment see
+        # vrel = 0 — so the padded bins carry zeros through EVERY
+        # iteration and the physical bins reproduce the unpadded solve
+        # (a 0.1 seed at a padded bin would pollute the early iterations'
+        # drag linearization instead).  None (every unbucketed caller)
+        # traces the exact historical program.
+        Xi0 = Cx(Xi0.re * wave.freq_mask[..., None].astype(dtype), Xi0.im)
     Z0 = impedance(wave.w, lin.M, lin.B, lin.C)
     if tik:
         # Tikhonov-style diagonal loading (ladder rung): lift each

@@ -1,0 +1,362 @@
+"""Shape-bucket ladder + mixed-design megabatch (raft_tpu/build/buckets.py,
+model.stage_designs, parallel.sweep.sweep_designs).
+
+Fast tier: ladder/bucketize/promotion host logic, frequency-padding
+invariants, and one tiny padded==unpadded compile.  Slow tier: the full
+parity matrix (all four shipped designs x multiple bucket classes), mixed
+sweep_designs vs per-design solo solves, health verdicts on padded lanes,
+chunked execution, and BEM-staged buckets.
+"""
+import copy
+import os
+
+import numpy as np
+import pytest
+import jax.numpy as jnp
+
+from raft_tpu.build import buckets
+from raft_tpu.build.members import build_member_set, member_counts
+from raft_tpu.model import (
+    _staged_wave,
+    load_design,
+    stage_design_base,
+    stage_designs,
+)
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+DESIGN_DIR = os.path.join(HERE, "..", "raft_tpu", "designs")
+ALL_DESIGNS = ["OC3spar", "VolturnUS-S", "OC4semi", "OC4semi_2"]
+
+
+def _path(name):
+    return os.path.join(DESIGN_DIR, name + ".yaml")
+
+
+KW = dict(nw=10, Hs=8.0, Tp=12.0, w_min=0.05, w_max=2.95)
+
+
+# --------------------------------------------------------------- ladder
+
+
+def test_ladder_default_and_env_override(monkeypatch):
+    ld = buckets.ladder()
+    assert ld == buckets.DEFAULT_LADDER
+    monkeypatch.setenv(buckets.ENV_VAR, "segments=8,24; nw=12,48")
+    ld = buckets.ladder()
+    assert ld["segments"] == (8, 24)
+    assert ld["nw"] == (12, 48)
+    assert ld["nodes"] == buckets.DEFAULT_LADDER["nodes"]  # untouched axis
+    # the salt must follow the override (AOT keys track the ladder)
+    assert "segments=8,24" in buckets.ladder_salt()[1]
+    monkeypatch.delenv(buckets.ENV_VAR)
+    assert "segments=8,24" not in buckets.ladder_salt()[1]
+
+
+@pytest.mark.parametrize("spec", [
+    "segments=8,4",              # not increasing
+    "segments=0,4",              # non-positive
+    "bogus=4",                   # unknown axis
+    "segments=a,b",              # non-integer
+    "segments 4",                # malformed entry
+])
+def test_ladder_rejects_bad_spec(monkeypatch, spec):
+    monkeypatch.setenv(buckets.ENV_VAR, spec)
+    with pytest.raises(ValueError):
+        buckets.ladder()
+
+
+def test_round_up_and_overflow():
+    ld = {"segments": (16, 48), "nodes": (64,), "nw": (16,)}
+    assert buckets.round_up(1, "segments", ld) == 16
+    assert buckets.round_up(16, "segments", ld) == 16
+    assert buckets.round_up(17, "segments", ld) == 48
+    with pytest.raises(buckets.BucketOverflow):
+        buckets.round_up(49, "segments", ld)
+
+
+def test_member_counts_match_unpadded_build():
+    for name in ALL_DESIGNS:
+        design = load_design(_path(name))
+        S, N = member_counts(design)
+        m = build_member_set(design)
+        assert m.seg_l.shape == (S,)
+        assert m.node_dls.shape == (N,)
+
+
+def test_bucketize_shipped_designs_share_classes():
+    # the default ladder is sized so the four shipped designs collapse to
+    # TWO buckets: OC3 + VolturnUS share the small class, the OC4s the
+    # medium one — the compile-collapse claim of the hetero smoke/bench
+    sigs = [buckets.bucketize(load_design(_path(n)), nw=100)
+            for n in ALL_DESIGNS]
+    assert sigs[0] == sigs[1]
+    assert sigs[2] == sigs[3]
+    assert sigs[0] != sigs[2]
+    assert all(s.nw == 128 for s in sigs)
+
+
+# ------------------------------------------------------------ promotion
+
+
+def test_promotion_self_heals_undersized_class():
+    design = load_design(_path("OC4semi"))       # 36 seg, 114 nodes
+    buckets.reset_promotions()
+    too_small = buckets.BucketSig(segments=16, nodes=64, nw=16)
+    m, sig = buckets.build_bucketed_member_set(design, too_small)
+    assert sig.segments >= 36 and sig.nodes >= 114
+    assert sig.nw == 16                           # untouched by promotion
+    assert m.seg_l.shape == (sig.segments,)
+    assert buckets.promotion_count() == 2         # both member axes bumped
+    # exact-fit class: no promotion
+    m2, sig2 = buckets.build_bucketed_member_set(design, sig)
+    assert sig2 == sig and buckets.promotion_count() == 2
+    buckets.reset_promotions()
+
+
+def test_stage_designs_promotions_are_per_call_not_cumulative():
+    """DesignBatch.promotions (and so the sweep's buckets stats block)
+    records THIS staging's promotions as a delta, not the process-wide
+    counter: promotions performed outside the call must not leak in."""
+    buckets.reset_promotions()
+    staged = stage_designs([_path("OC3spar")], with_mooring=False, **KW)
+    assert all(b.promotions == 0 for b in staged.values())
+    # promote outside any staging call (stale undersized class)
+    buckets.build_bucketed_member_set(
+        load_design(_path("OC4semi")),
+        buckets.BucketSig(segments=16, nodes=64, nw=16))
+    assert buckets.promotion_count() == 2
+    staged = stage_designs([_path("OC3spar")], with_mooring=False, **KW)
+    assert all(b.promotions == 0 for b in staged.values())
+    buckets.reset_promotions()
+
+
+def test_promotion_raises_past_ladder_top(monkeypatch):
+    monkeypatch.setenv(buckets.ENV_VAR, "segments=16;nodes=64")
+    design = load_design(_path("OC4semi"))
+    with pytest.raises(buckets.BucketOverflow):
+        buckets.build_bucketed_member_set(
+            design, buckets.BucketSig(segments=16, nodes=64, nw=None))
+
+
+# ------------------------------------------------- frequency-grid padding
+
+
+def test_staged_wave_padding_invariants():
+    w0 = _staged_wave(10, 0.05, 2.95, 300.0, 8.0, 12.0)
+    wp = _staged_wave(10, 0.05, 2.95, 300.0, 8.0, 12.0, nw_pad=16)
+    assert w0.freq_mask is None                   # unbucketed: old pytree
+    assert wp.w.shape == (16,)
+    np.testing.assert_array_equal(np.asarray(wp.freq_mask),
+                                  np.arange(16) < 10)
+    # physical bins identical, padded bins: same spacing, zero amplitude
+    np.testing.assert_allclose(np.asarray(wp.w[:10]), np.asarray(w0.w))
+    np.testing.assert_allclose(np.diff(np.asarray(wp.w)),
+                               float(w0.w[1] - w0.w[0]), rtol=1e-12)
+    np.testing.assert_array_equal(np.asarray(wp.zeta[10:]), 0.0)
+    np.testing.assert_allclose(np.asarray(wp.zeta[:10]),
+                               np.asarray(w0.zeta))
+    with pytest.raises(ValueError):
+        _staged_wave(10, 0.05, 2.95, 300.0, 8.0, 12.0, nw_pad=8)
+
+
+def test_padded_forward_parity_fast():
+    """One tiny compile: bucket-padded OC3 (members + frequency grid)
+    reproduces the unpadded solve exactly — same iteration count, padded
+    bins exactly zero, physical bins at float eps."""
+    from raft_tpu.parallel import forward_response
+
+    fn = _path("OC3spar")
+    _, m0, rna, env, w0, C = stage_design_base(fn, **KW)
+    _, mp, _, _, wp, _ = stage_design_base(fn, bucket=True, **KW)
+    assert wp.w.shape[0] == 16 and w0.w.shape[0] == 10
+    o0 = forward_response(m0, rna, env, w0, C, n_iter=20, method="while")
+    op = forward_response(mp, rna, env, wp, C, n_iter=20, method="while")
+    assert int(o0.n_iter) == int(op.n_iter)
+    a0 = np.asarray(o0.Xi.abs2())
+    ap = np.asarray(op.Xi.abs2())
+    np.testing.assert_array_equal(ap[10:], 0.0)   # padded bins exactly 0
+    np.testing.assert_allclose(ap[:10], a0, rtol=1e-9, atol=1e-12)
+
+
+# ------------------------------------------------------- staging/grouping
+
+
+def test_stage_designs_groups_and_stacks():
+    staged = stage_designs([_path(n) for n in ALL_DESIGNS],
+                           with_mooring=False, **KW)
+    assert len(staged) == 2
+    D = 0
+    for sig, b in staged.items():
+        B = len(b.fnames)
+        D += B
+        assert b.members.seg_rA.shape == (B, sig.segments, 3)
+        assert b.wave.w.shape == (B, sig.nw)
+        assert b.wave.freq_mask.shape == (B, sig.nw)
+        assert b.C_moor is None                   # with_mooring=False
+        assert np.asarray(b.env.depth).shape == (B,)
+        assert b.nw == KW["nw"]
+    idx = sorted(i for b in staged.values() for i in b.indices)
+    assert idx == list(range(4)) and D == 4
+
+
+def test_stage_designs_accepts_dicts_and_validates_bems():
+    d = load_design(_path("OC3spar"))
+    staged = stage_designs([d, copy.deepcopy(d)], with_mooring=False, **KW)
+    (b,) = staged.values()
+    assert len(b.fnames) == 2
+    with pytest.raises(ValueError, match="bems"):
+        stage_designs([d, d], bems=[None], with_mooring=False, **KW)
+    with pytest.raises(ValueError, match="every design"):
+        stage_designs([d, d], bems=[None, None], with_mooring=False, **KW)
+
+
+# ----------------------------------------------------- slow parity matrix
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("name", ALL_DESIGNS)
+def test_padded_parity_multi_bucket_sizes(name):
+    """Padded == unpadded at FLOAT EPS for every shipped design, at its
+    natural bucket class AND one class larger on every axis — the masking
+    invariant must hold regardless of how much padding the ladder adds."""
+    from raft_tpu.parallel import forward_response, response_std
+
+    fn = _path(name)
+    design = load_design(fn)
+    _, m0, rna, env, w0, C = stage_design_base(fn, **KW)
+    o0 = forward_response(m0, rna, env, w0, C, n_iter=30, method="while")
+    s0 = np.asarray(response_std(o0.Xi.abs2(), w0.w))
+    scale = np.max(np.abs(s0))
+
+    ld = buckets.ladder()
+    nat = buckets.bucketize(design, nw=KW["nw"], ld=ld)
+
+    def next_class(axis, v):
+        classes = ld[axis]
+        i = classes.index(v)
+        return classes[min(i + 1, len(classes) - 1)]
+
+    bigger = buckets.BucketSig(
+        segments=next_class("segments", nat.segments),
+        nodes=next_class("nodes", nat.nodes),
+        nw=next_class("nw", nat.nw))
+    for sig in (nat, bigger):
+        _, mp, _, _, wp, _ = stage_design_base(fn, bucket=sig, **KW)
+        op = forward_response(mp, rna, env, wp, C, n_iter=30,
+                              method="while")
+        assert int(op.n_iter) == int(o0.n_iter)
+        sp = np.asarray(response_std(op.Xi.abs2(), wp.w))
+        # scale-relative: unexcited symmetric DOFs are exact/noise zeros
+        assert np.max(np.abs(sp - s0)) / scale < 1e-9
+        np.testing.assert_array_equal(
+            np.asarray(op.Xi.abs2())[KW["nw"]:], 0.0)
+
+
+@pytest.mark.slow
+def test_sweep_designs_mixed_vs_solo_with_health():
+    """The megabatch contract: a mixed 4-platform batch solves per-design
+    identically to solo sweeps (iteration counts included), and health
+    verdicts hold on the padded lanes."""
+    from raft_tpu.parallel import forward_response, response_std, sweep_designs
+
+    fnames = [_path(n) for n in ALL_DESIGNS]
+    out = sweep_designs(fnames, n_iter=30, health=True, **KW)
+    assert out["buckets"]["n_buckets"] == 2
+    assert out["converged"].all() and out["finite"].all()
+    assert out["health"]["n_quarantined"] == 0
+    for i, fn in enumerate(fnames):
+        _, m, rna, env, wv, C = stage_design_base(fn, **KW)
+        o = forward_response(m, rna, env, wv, C, n_iter=30)
+        s = np.asarray(response_std(o.Xi.abs2(), wv.w))
+        assert int(out["iterations"][i]) == int(o.n_iter)
+        assert np.max(np.abs(out["std dev"][i] - s)) / np.max(np.abs(s)) < 1e-9
+    # Xi_abs2 trimmed to the physical grid in design order
+    assert out["Xi_abs2"].shape == (4, KW["nw"], 6)
+
+
+@pytest.mark.slow
+def test_sweep_designs_bad_lane_quarantined_mates_untouched():
+    """Per-lane resilience inside a bucket: a NaN design (bad drag
+    coefficient) is quarantined and reported unsalvaged, while its
+    bucket-mates' results are BITWISE those of a clean batch."""
+    from raft_tpu.parallel import sweep_designs
+
+    d0, dv = _path("OC3spar"), _path("VolturnUS-S")
+    bad = copy.deepcopy(load_design(d0))
+    bad["platform"]["members"][0]["Cd"] = float("nan")
+    ref = sweep_designs([d0, dv], n_iter=30, **KW)
+    out = sweep_designs([d0, bad, dv], n_iter=30, health=True,
+                        escalate=True, **KW)
+    assert list(out["health"]["quarantined"]) == [1]
+    assert list(out["health"]["unsalvaged"]) == [1]
+    assert not out["finite"][1]
+    assert out["converged"][[0, 2]].all() and out["finite"][[0, 2]].all()
+    np.testing.assert_array_equal(out["std dev"][0], ref["std dev"][0])
+    np.testing.assert_array_equal(out["std dev"][2], ref["std dev"][1])
+
+
+@pytest.mark.slow
+def test_sweep_designs_starved_lanes_salvaged():
+    """Iteration-starved lanes walk the escalation ladder to the
+    full-budget fixed point — per design, inside the padded batch."""
+    from raft_tpu.parallel import sweep_designs
+
+    fnames = [_path("OC3spar"), _path("VolturnUS-S")]
+    ref = sweep_designs(fnames, n_iter=30, **KW)
+    out = sweep_designs(fnames, n_iter=2, health=True, **KW)
+    assert out["health"]["n_quarantined"] == 2
+    assert out["health"]["salvaged"] == 2
+    assert out["converged"].all()
+    scale = np.max(np.abs(ref["std dev"]))
+    assert np.max(np.abs(out["std dev"] - ref["std dev"])) / scale < 1e-6
+
+
+@pytest.mark.slow
+def test_sweep_designs_chunked_matches_unchunked():
+    from raft_tpu.parallel import sweep_designs
+
+    fnames = [_path("OC3spar"), _path("VolturnUS-S")] * 2
+    ref = sweep_designs(fnames, n_iter=30, **KW)
+    out = sweep_designs(fnames, n_iter=30, chunk=2, **KW)
+    np.testing.assert_array_equal(out["std dev"], ref["std dev"])
+    assert out["pipeline"]                        # per-bucket stats present
+    # bucket sizes are emergent, so an awkward chunk request CLAMPS to a
+    # divisor per bucket instead of failing: 3 + 1 lanes with chunk=2
+    # degrades to lane-sized chunks, same results
+    mix = fnames[:3] + [_path("OC4semi")]
+    ref2 = sweep_designs(mix, n_iter=30, **KW)
+    out2 = sweep_designs(mix, chunk=2, n_iter=30, **KW)
+    np.testing.assert_array_equal(out2["std dev"], ref2["std dev"])
+
+
+@pytest.mark.slow
+def test_sweep_designs_with_staged_bem_parity():
+    """Synthetic per-design BEM tuples staged batch-leading: the padded
+    mixed batch matches solo forward_response with stage_bem."""
+    from raft_tpu.parallel import (
+        forward_response, response_std, stage_bem, sweep_designs,
+    )
+
+    fnames = [_path("OC3spar"), _path("VolturnUS-S")]
+    nw = KW["nw"]
+
+    def synth(seed):
+        r = np.random.default_rng(seed)
+        A = r.normal(size=(6, 6, nw)) * 1e5
+        A = A + A.transpose(1, 0, 2)              # symmetric-ish
+        B = np.abs(r.normal(size=(6, 6, nw))) * 1e4
+        B = B + B.transpose(1, 0, 2)
+        F = (r.normal(size=(6, nw)) + 1j * r.normal(size=(6, nw))) * 1e4
+        return A, B, F
+
+    bems = [synth(i) for i in range(len(fnames))]
+    out = sweep_designs(fnames, bems=bems, n_iter=30, **KW)
+    for i, fn in enumerate(fnames):
+        _, m, rna, env, wv, C = stage_design_base(fn, **KW)
+        o = forward_response(m, rna, env, wv, C,
+                             bem=stage_bem(bems[i], wv), n_iter=30)
+        s = np.asarray(response_std(o.Xi.abs2(), wv.w))
+        assert np.max(np.abs(out["std dev"][i] - s)) / np.max(np.abs(s)) < 1e-9
+    # chunk + bems compose: the BEM batch must be sliced with the lanes
+    out2 = sweep_designs(fnames * 2, bems=bems * 2, n_iter=30, chunk=2, **KW)
+    np.testing.assert_array_equal(out2["std dev"][:2], out["std dev"])

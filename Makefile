@@ -2,13 +2,16 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke lint
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke lint lint-budgets
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
 
-lint:            ## graftlint: static rules vs baseline + trace audit
+lint:            ## graftlint: static rules vs baseline + trace audit + compiled-artifact budget gate
 	python -m raft_tpu.lint --audit
+
+lint-budgets:    ## refresh lint/budgets.json after an INTENTIONAL compiled-artifact change
+	python -m raft_tpu.lint --write-budgets   # review the diff like code
 
 cache-smoke:     ## warm-start proof: tiny sweep twice in fresh processes,
 	python -m raft_tpu.cache smoke   # 2nd run's compile must be < 50% of 1st

@@ -556,7 +556,7 @@ def hetero_buckets(nw: int = 64, n_iter: int = 30):
     recorded; the padded lanes must reproduce the unpadded physics).
 
     Compile counts come from the AOT registry's own compile-event log
-    (``raft_tpu.cache.aot.compile_events``): an executable served from any
+    (``raft_tpu.cache.aot.compile_count``): an executable served from any
     warm layer (memo / disk / persistent XLA cache) is NOT an event, so a
     warm process legitimately reports zero compiles for both streams.
     """
@@ -570,13 +570,16 @@ def hetero_buckets(nw: int = 64, n_iter: int = 30):
               for n in names]
     kw = dict(nw=nw, Hs=8.0, Tp=12.0, w_min=0.05, w_max=2.95)
 
-    e0 = len(cache.compile_events("sweep_designs"))
+    # compile_count, not len(compile_events()): the event log is a
+    # bounded ring, so len() deltas can undercount in a long multi-phase
+    # run; the per-tag counters stay exact past the wrap
+    e0 = cache.compile_count("sweep_designs")
     t0 = time.perf_counter()
     out = sweep_designs(fnames, n_iter=n_iter, return_xi=False, **kw)
     dt_mixed = time.perf_counter() - t0
-    compiles = len(cache.compile_events("sweep_designs")) - e0
+    compiles = cache.compile_count("sweep_designs") - e0
 
-    s0 = len(cache.compile_events("bench.hetero_solo"))
+    s0 = cache.compile_count("bench.hetero_solo")
     errs = []
     t0 = time.perf_counter()
     for i, fn in enumerate(fnames):
@@ -597,7 +600,7 @@ def hetero_buckets(nw: int = 64, n_iter: int = 30):
         errs.append(float(np.max(np.abs(out["std dev"][i] - sig))
                           / np.max(np.abs(sig))))
     dt_solo = time.perf_counter() - t0
-    solo_compiles = len(cache.compile_events("bench.hetero_solo")) - s0
+    solo_compiles = cache.compile_count("bench.hetero_solo") - s0
     bk = out["buckets"]
     return {
         "designs": names,
@@ -610,7 +613,7 @@ def hetero_buckets(nw: int = 64, n_iter: int = 30):
         "cache_enabled": cache.is_enabled(),
         # compile-collapse claim: mixed stream pays one compile per
         # BUCKET (zero when warm); the per-design solo stream pays one
-        # per DESIGN.  compile_events only records through the AOT
+        # per DESIGN.  compile counting only sees the AOT
         # registry — with the cache disabled there is nothing to measure,
         # so the claim fields are null rather than vacuously true
         "compiles_mixed": compiles if cache.is_enabled() else None,

@@ -57,7 +57,7 @@ def _child(argv) -> None:
     kw = dict(nw=args.nw, Hs=8.0, Tp=12.0, w_min=0.05, w_max=2.95)
 
     out = sweep_designs(fnames, n_iter=30, return_xi=False, **kw)
-    compiles = len(cache.compile_events("sweep_designs"))
+    compiles = cache.compile_count("sweep_designs")
 
     # per-design solo reference (unpadded, un-bucketed) for the parity leg
     errs = []

@@ -33,8 +33,10 @@ from raft_tpu.cache.aot import (  # noqa: F401
     cached_callable,
     cached_compile,
     callable_salt,
+    compile_count,
     compile_events,
     donation_salt,
+    reset_compile_events,
 )
 from raft_tpu.cache.staging import FileKey, cached_arrays, staging_key  # noqa: F401
 from raft_tpu.cache.stats import report  # noqa: F401

@@ -42,6 +42,7 @@ import inspect
 import os
 import tempfile
 import time
+from collections import Counter, deque
 
 import numpy as np
 
@@ -53,16 +54,43 @@ _mem: dict = {}
 # tags of executables that were ACTUALLY lowered+compiled in this process
 # (every reuse layer missed) — the evidence stream behind compile-count
 # claims like "a mixed design stream compiles once per shape bucket":
-# bench.py's buckets block and `make hetero-smoke` read it
-_compile_events: list = []
+# bench.py's buckets block and `make hetero-smoke` read it.  BOUNDED: a
+# long-lived process (the ROADMAP solver daemon) or a multi-phase bench
+# run must not grow it without limit, so the ordered log is a ring of
+# the most recent _COMPILE_EVENTS_MAX tags while exact per-tag totals
+# since process start (or the last reset) live in _compile_counts —
+# count deltas stay correct even after the ring has wrapped.
+_COMPILE_EVENTS_MAX = 4096
+_compile_events: deque = deque(maxlen=_COMPILE_EVENTS_MAX)
+_compile_counts: Counter = Counter()
 
 
 def compile_events(tag: str | None = None) -> list:
     """Tags compiled (not served from any warm layer) in this process, in
-    order; filtered to one ``tag`` when given."""
+    order; filtered to one ``tag`` when given.  The log is a bounded ring
+    (:data:`_COMPILE_EVENTS_MAX` most recent events); for counting across
+    long windows prefer :func:`compile_count`, which never saturates."""
     if tag is None:
         return list(_compile_events)
     return [t for t in _compile_events if t == tag]
+
+
+def compile_count(tag: str | None = None) -> int:
+    """Exact number of real compiles since process start (or the last
+    :func:`reset_compile_events`): per ``tag``, or total.  Unlike
+    ``len(compile_events(tag))`` this stays exact after the bounded
+    event ring wraps."""
+    if tag is None:
+        return sum(_compile_counts.values())
+    return _compile_counts.get(tag, 0)
+
+
+def reset_compile_events() -> None:
+    """Zero the compile-event log AND counters — phase boundaries of
+    long-lived processes (bench passes, a resident solver service)
+    measure per-window compile counts without unbounded growth."""
+    _compile_events.clear()
+    _compile_counts.clear()
 
 
 def _version_salts() -> tuple:
@@ -341,6 +369,7 @@ def cached_compile(tag: str, fn, args, *, consts=(), mesh=None,
     cold_s = time.perf_counter() - t0
     stats.record("aot", "miss")
     _compile_events.append(tag)
+    _compile_counts[tag] += 1
     _try_store(key, compiled, cold_s)
     _mem[key] = compiled
     return compiled
@@ -367,4 +396,4 @@ def cached_callable(tag: str, fn, args, *, consts=(), mesh=None,
 def clear_memory() -> None:
     """Drop the in-process memo (tests)."""
     _mem.clear()
-    _compile_events.clear()
+    reset_compile_events()

@@ -5,7 +5,8 @@
 1. the fast test tier (``pytest -m "not slow"``),
 2. graftlint (``python -m raft_tpu.lint --audit``: static rules vs the
    committed baseline + the trace-audit budgets over every registered
-   entry point),
+   entry point + the compiled-artifact budget gate vs
+   ``lint/budgets.json``, surfaced as the ``lint.budgets`` block),
 3. the multi-chip dry run (``__graft_entry__.dryrun_multichip(8)``) in a
    fresh subprocess under the same kind of wall-clock budget the driver
    applies,
@@ -79,6 +80,12 @@ def main():
             break
         except json.JSONDecodeError:
             continue
+    # compiled-artifact budget gate (per-entry cost/memory metrics +
+    # pass/fail vs lint/budgets.json): one key deep in the round
+    # artifact, so an ahead-of-time perf regression is never buried
+    bj = (lint.get("json") or {}).get("budgets")
+    if bj is not None:
+        lint["budgets"] = bj
     evidence["lint"] = lint
 
     print("[evidence] dryrun_multichip(8) ...", flush=True)

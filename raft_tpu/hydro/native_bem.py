@@ -209,11 +209,27 @@ def solve_bem(
                 else os.path.expanduser("~/.cache/raft_tpu/bem"))
         key = os.path.join(base, h.hexdigest()[:24] + ".npz")
         if os.path.exists(key):
-            z = np.load(key)
-            out = (z["A"], z["B"], z["F"][0] if scalar_beta else z["F"])
-            if haskind:
-                return out + ((z["Fh"][0] if scalar_beta else z["Fh"]),)
-            return out
+            # corruption tolerance (the staging-cache rule): a truncated
+            # or otherwise unreadable artifact is a MISS — deleted and
+            # recomputed, never served and never allowed to crash every
+            # future run with the same geometry
+            try:
+                with np.load(key) as z:
+                    names = set(z.files)
+                    needed = {"A", "B", "F"} | ({"Fh"} if haskind else set())
+                    if not needed <= names:
+                        raise KeyError(sorted(needed - names))
+                    out = (z["A"], z["B"],
+                           z["F"][0] if scalar_beta else z["F"])
+                    if haskind:
+                        return out + ((z["Fh"][0] if scalar_beta
+                                       else z["Fh"]),)
+                    return out
+            except Exception:
+                try:
+                    os.unlink(key)
+                except OSError:
+                    pass
 
     lib = _load()
     A = np.zeros((n_w, 6, 6))
@@ -241,10 +257,23 @@ def solve_bem(
 
     if cache and key is not None:
         os.makedirs(os.path.dirname(key), exist_ok=True)
-        if haskind:
-            np.savez_compressed(key, A=A, B=B, F=F, Fh=Fh)
-        else:
-            np.savez_compressed(key, A=A, B=B, F=F)
+        # atomic publish (tmp + os.replace): a kill mid-write must never
+        # leave a truncated npz under the content-addressed key — the
+        # freshness check is existence, so the torn file would be served
+        # (GL202, the same contract as cache/staging.py and checkpoint.py)
+        import tempfile
+
+        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(key), suffix=".tmp")
+        try:
+            with os.fdopen(fd, "wb") as f:
+                payload = dict(A=A, B=B, F=F)
+                if haskind:
+                    payload["Fh"] = Fh
+                np.savez_compressed(f, **payload)
+            os.replace(tmp, key)
+        finally:
+            if os.path.exists(tmp):
+                os.unlink(tmp)
     if scalar_beta:
         F = F[0]
         Fh = Fh[0] if haskind else None

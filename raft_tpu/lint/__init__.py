@@ -1,23 +1,34 @@
-"""graftlint: JAX-aware static analysis + trace audit for raft_tpu.
+"""graftlint: JAX-aware static analysis + trace + budget audit for raft_tpu.
 
-Two complementary passes keep the hot path recompile-free and dtype-clean:
+Three complementary passes keep the hot path recompile-free, dtype-clean
+and contract-honest:
 
-* the **static pass** (:mod:`raft_tpu.lint.rules`) — AST rules GL101-GL107
-  over the package source: numpy-on-tracer, host casts, traced Python
-  branches, ``static_argnames`` hazards, float64 literals, host syncs in
-  jitted code, nondeterministic set/listdir iteration near cache keys;
+* the **static pass** (:mod:`raft_tpu.lint.rules`) — AST purity rules
+  GL101-GL107 (numpy-on-tracer, host casts, traced Python branches,
+  ``static_argnames`` hazards, float64 literals, host syncs in jitted
+  code, nondeterministic set/listdir iteration near cache keys) plus the
+  contract rules GL201-GL204 (env-knob registration + AOT-key salting
+  against :mod:`raft_tpu.lint.knobs`, atomic tmp+``os.replace`` publish
+  under durable cache roots, hard subprocess timeouts, donation routed
+  through the key-salted AOT registry);
 * the **trace audit** (:mod:`raft_tpu.lint.audit`) — abstractly traces
   every registered public entry point (north-star sweep, DLC solve,
-  frequency-sharded forward, co-design val_grad, eigen) under
-  ``jax.make_jaxpr`` and asserts per-jaxpr budgets: zero retraces for a
-  repeated same-shape call, zero float64 leaves under x32, zero host
-  callbacks.
+  frequency-sharded forward, co-design val_grad, eigen, fused RAO
+  solve, bucketed sweep_designs) under ``jax.make_jaxpr`` and asserts
+  per-jaxpr budgets: zero retraces for a repeated same-shape call, zero
+  float64 leaves under x32, zero host callbacks;
+* the **compiled-artifact budget audit** (same module) — AOT-lowers
+  each entry and holds its ``cost_analysis()``/``memory_analysis()``
+  metrics (flops, bytes accessed, temp/peak bytes, eqn counts) to the
+  committed ``raft_tpu/lint/budgets.json`` within tolerance, with
+  ``--write-budgets`` as the intentional-change refresh path.
 
-CLI: ``python -m raft_tpu.lint [--audit] [--write-baseline] [paths...]``
-(exit 0 clean, 1 on new violations / budget breaches).  A committed
-baseline (``raft_tpu/lint/baseline.json``) triages pre-existing findings:
-only violations NOT in the baseline fail the run.  Suppression syntax and
-the rule catalog are documented in ``docs/lint.rst``.
+CLI: ``python -m raft_tpu.lint [--audit] [--write-baseline]
+[--write-budgets] [paths...]`` (exit 0 clean, 1 on new violations /
+budget breaches).  A committed baseline (``raft_tpu/lint/baseline.json``)
+triages pre-existing findings: only violations NOT in the baseline fail
+the run.  Suppression syntax and the rule catalog are documented in
+``docs/lint.rst``.
 """
 from raft_tpu.lint.rules import (  # noqa: F401
     RULES,

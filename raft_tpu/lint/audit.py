@@ -22,7 +22,20 @@ For every :mod:`raft_tpu.lint.registry` entry the audit
    identical abstract signatures means something non-hashable or
    value-dependent leaked into the trace (the recompile hazard that
    erases the warm-start wins: PR 1 measured >94% of cold wall-clock in
-   XLA compilation).
+   XLA compilation);
+
+3. runs the **compiled-artifact budget audit**: AOT-lowers the entry
+   (``jax.jit(fn).lower(*args).compile()``, still under x32) and records
+   the compiler's own accounting — ``cost_analysis()`` flops and bytes
+   accessed, ``memory_analysis()`` argument/output/temp byte sizes (the
+   HBM peak proxy), plus the jaxpr equation and sub-jaxpr counts —
+   against the committed ``lint/budgets.json``.  A trace audit alone
+   cannot see a perf regression that only exists in the compiled
+   artifact (an extra fusion barrier, a doubled temp buffer, a
+   broadcast materialized in HBM); the budget gate can, ahead of any
+   hardware run.  Budgets are per backend platform (CI pins CPU);
+   ``--write-budgets`` refreshes them after an intentional change, and
+   regressions beyond the stated tolerance fail ``make lint``.
 
 ``run_audit()`` returns one :class:`AuditReport` per entry;
 ``main``-level consumers (CLI ``--audit``, ``make lint``, the fast test
@@ -31,11 +44,20 @@ tier) fail on any ``ok=False`` report.
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 import time
 
 _HOST_CALLBACK_PRIMS = {"pure_callback", "io_callback", "debug_callback",
                         "callback"}
 _WIDE_DTYPES = ("float64", "complex128")
+
+DEFAULT_BUDGETS = os.path.join(os.path.dirname(os.path.abspath(__file__)),
+                               "budgets.json")
+#: a metric may grow this fraction over its committed budget before the
+#: gate fails (absorbs jax/XLA version wiggle without hiding a real
+#: regression; per-entry "_tolerance" overrides)
+DEFAULT_TOLERANCE = 0.25
 
 
 @dataclasses.dataclass
@@ -49,6 +71,10 @@ class AuditReport:
     retraces: int               # extra traces on a same-shape call (0)
     trace_s: float
     ok: bool
+    # compiled-artifact budget audit (None when not run)
+    metrics: dict | None = None
+    budget_ok: bool | None = None
+    budget_notes: list = dataclasses.field(default_factory=list)
 
     def to_dict(self) -> dict:
         d = dataclasses.asdict(self)
@@ -57,10 +83,20 @@ class AuditReport:
 
     def summary(self) -> str:
         state = "ok" if self.ok else "FAIL"
-        return (f"[audit] {self.name}: {state} — {self.n_eqns} eqns, "
+        line = (f"[audit] {self.name}: {state} — {self.n_eqns} eqns, "
                 f"f64 leaves {self.f64_leaves}, host callbacks "
                 f"{self.host_callbacks}, retraces {self.retraces} "
                 f"({self.trace_s:.2f}s)")
+        if self.budget_ok is not None:
+            m = self.metrics or {}
+            line += (f"\n[audit]   budget: "
+                     f"{'ok' if self.budget_ok else 'FAIL'} — "
+                     f"flops {m.get('flops', '?')}, bytes "
+                     f"{m.get('bytes_accessed', '?')}, temp "
+                     f"{m.get('temp_bytes', '?')}")
+            for note in self.budget_notes:
+                line += f"\n[audit]     {note}"
+        return line
 
 
 def _iter_jaxprs(jaxpr):
@@ -150,16 +186,145 @@ def _count_retraces(fn, args, args2) -> int:
     return traces[0] - 1
 
 
-def audit_entry(entry, retrace_check: bool = True) -> AuditReport:
+def compiled_metrics(compiled, n_eqns: int, n_jaxprs: int) -> dict:
+    """Compiler-side accounting of one AOT-compiled executable, keyed by
+    stable metric names.  Metrics a backend does not report are simply
+    absent — the budget check treats a committed-but-unavailable metric
+    as a failure (a gate that silently stops measuring is no gate)."""
+    m: dict = {"n_eqns": int(n_eqns), "n_jaxprs": int(n_jaxprs)}
+    try:
+        ca = compiled.cost_analysis()
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else {}
+        if isinstance(ca, dict):
+            for src, dst in (("flops", "flops"),
+                             ("bytes accessed", "bytes_accessed"),
+                             ("transcendentals", "transcendentals")):
+                v = ca.get(src)
+                if v is not None and float(v) == float(v):
+                    m[dst] = float(v)
+    except Exception:
+        pass
+    try:
+        ma = compiled.memory_analysis()
+        if ma is not None:
+            for attr, dst in (
+                    ("temp_size_in_bytes", "temp_bytes"),
+                    ("argument_size_in_bytes", "argument_bytes"),
+                    ("output_size_in_bytes", "output_bytes"),
+                    ("generated_code_size_in_bytes", "code_bytes")):
+                v = getattr(ma, attr, None)
+                if isinstance(v, (int, float)):
+                    m[dst] = int(v)
+            if all(k in m for k in ("temp_bytes", "argument_bytes",
+                                    "output_bytes")):
+                # HBM peak proxy: everything the executable holds live
+                m["peak_bytes"] = (m["temp_bytes"] + m["argument_bytes"]
+                                   + m["output_bytes"])
+    except Exception:
+        pass
+    return m
+
+
+def load_budgets(path: str | None = None) -> dict:
+    path = path or DEFAULT_BUDGETS
+    if not os.path.exists(path):
+        return {"tolerance": DEFAULT_TOLERANCE, "platforms": {}}
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    data.setdefault("tolerance", DEFAULT_TOLERANCE)
+    data.setdefault("platforms", {})
+    return data
+
+
+def save_budgets(reports, path: str | None = None,
+                 platform: str | None = None) -> str:
+    """Merge ``reports``' metrics into the budgets file for ``platform``
+    (default: the current jax backend).  Other platforms' committed
+    budgets are preserved."""
+    import jax
+
+    path = path or DEFAULT_BUDGETS
+    platform = platform or jax.default_backend()
+    data = load_budgets(path)
+    plat = data["platforms"].setdefault(platform, {})
+    for r in reports:
+        if r.metrics:
+            fresh = {k: r.metrics[k] for k in sorted(r.metrics)}
+            # a refresh replaces the MEASURED values only: "_"-prefixed
+            # keys ("_tolerance" overrides, annotations) are maintainer
+            # state and survive the rewrite
+            fresh.update({k: v for k, v in plat.get(r.name, {}).items()
+                          if k.startswith("_")})
+            plat[r.name] = fresh
+    data["_comment"] = (
+        "graftlint compiled-artifact budgets: per-platform, per-entry "
+        "cost_analysis()/memory_analysis() metrics of the registered "
+        "audit entries; the gate fails when a metric regresses beyond "
+        "'tolerance'. Refresh with `python -m raft_tpu.lint "
+        "--write-budgets` and review the diff like any code change.")
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(data, f, indent=1, sort_keys=True)
+        f.write("\n")
+    return path
+
+
+def check_budget(name: str, metrics: dict | None, budgets: dict,
+                 platform: str) -> tuple:
+    """(ok, notes) of one entry's metrics against the committed budget.
+
+    Fails on: no committed budget for (platform, entry), a committed
+    metric the current run cannot measure, or a committed metric grown
+    beyond tolerance.  Shrinking beyond tolerance is reported as a
+    non-failing note — refresh the budgets to bank the improvement."""
+    plat = budgets.get("platforms", {}).get(platform)
+    if not plat or name not in plat:
+        return False, [f"no committed budget for entry {name!r} on "
+                       f"platform {platform!r} — run `python -m "
+                       f"raft_tpu.lint --write-budgets`"]
+    entry_budget = plat[name]
+    tol = float(entry_budget.get("_tolerance",
+                                 budgets.get("tolerance",
+                                             DEFAULT_TOLERANCE)))
+    ok = True
+    notes: list = []
+    for metric, bv in sorted(entry_budget.items()):
+        if metric.startswith("_"):
+            continue
+        cur = (metrics or {}).get(metric)
+        if cur is None:
+            ok = False
+            notes.append(f"{metric}: committed {bv} but unavailable in "
+                         f"this run — the gate cannot verify it")
+        elif cur > bv * (1.0 + tol) and cur > bv + 1:
+            ok = False
+            notes.append(f"{metric}: {cur} exceeds budget {bv} "
+                         f"(+{100.0 * (cur / bv - 1.0) if bv else 100.0:.1f}%"
+                         f" > tol {100.0 * tol:.0f}%) — a compiled-artifact "
+                         f"regression; if intentional, refresh with "
+                         f"--write-budgets")
+        elif bv and cur < bv * (1.0 - tol):
+            notes.append(f"note: {metric}: {cur} is far below budget {bv} "
+                         f"— refresh budgets to bank the improvement")
+    return ok, notes
+
+
+def audit_entry(entry, retrace_check: bool = True,
+                collect_metrics: bool = False) -> AuditReport:
     """Run all budgets for one registry entry **in x32 mode**."""
     import jax
     from jax.experimental import disable_x64
 
     t0 = time.perf_counter()
+    metrics = None
     with disable_x64():
         fn, args, args2 = entry.build()
         jaxpr = jax.make_jaxpr(fn)(*args)
         n_eqns, wide, examples, callbacks = audit_jaxpr(jaxpr)
+        if collect_metrics:
+            n_jaxprs = sum(1 for _ in _iter_jaxprs(jaxpr.jaxpr))
+            compiled = jax.jit(fn).lower(*args).compile()
+            metrics = compiled_metrics(compiled, n_eqns, n_jaxprs)
         retraces = (_count_retraces(fn, args, args2)
                     if retrace_check else 0)
     return AuditReport(
@@ -172,12 +337,39 @@ def audit_entry(entry, retrace_check: bool = True) -> AuditReport:
         retraces=retraces,
         trace_s=time.perf_counter() - t0,
         ok=(wide == 0 and callbacks == 0 and retraces == 0),
+        metrics=metrics,
     )
 
 
-def run_audit(names=None, retrace_check: bool = True) -> list[AuditReport]:
-    """Audit the named entries (default: every registered entry)."""
+def run_audit(names=None, retrace_check: bool = True,
+              budget_check: bool = True,
+              budgets_path: str | None = None) -> list[AuditReport]:
+    """Audit the named entries (default: every registered entry).  With
+    ``budget_check`` each entry is additionally AOT-lowered and its
+    compiled-artifact metrics held to the committed budgets; a budget
+    breach (or a missing budget) marks the report ``ok=False``."""
+    import jax
+
     from raft_tpu.lint.registry import get_entries
 
-    return [audit_entry(e, retrace_check=retrace_check)
-            for e in get_entries(names)]
+    reports = [audit_entry(e, retrace_check=retrace_check,
+                           collect_metrics=budget_check)
+               for e in get_entries(names)]
+    if budget_check:
+        budgets = load_budgets(budgets_path)
+        platform = jax.default_backend()
+        for r in reports:
+            r.budget_ok, r.budget_notes = check_budget(
+                r.name, r.metrics, budgets, platform)
+            r.ok = r.ok and r.budget_ok
+    return reports
+
+
+def write_budgets(names=None, path: str | None = None) -> tuple:
+    """Collect metrics for the named entries (default: all) and merge
+    them into the budgets file.  Returns (path, reports)."""
+    from raft_tpu.lint.registry import get_entries
+
+    reports = [audit_entry(e, retrace_check=False, collect_metrics=True)
+               for e in get_entries(names)]
+    return save_budgets(reports, path), reports

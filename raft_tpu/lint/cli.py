@@ -2,19 +2,23 @@
 
 Modes (composable):
 
-* default — static AST pass over the package (GL101-GL107), compared
-  against the committed baseline; exit 1 on any NEW violation;
+* default — static AST pass over the package (GL101-GL107 purity rules
+  + GL201-GL204 contract rules), compared against the committed
+  baseline; exit 1 on any NEW violation;
 * ``--audit`` — additionally run the trace audit over the registered
-  entry points (retrace / f64 / host-callback budgets); exit 1 on any
-  budget breach;
+  entry points (retrace / f64 / host-callback budgets) AND the
+  compiled-artifact budget audit (cost/memory metrics vs the committed
+  ``lint/budgets.json``); exit 1 on any budget breach;
 * ``--write-baseline`` — regenerate the baseline from the current tree
   (triage mode) and exit 0;
+* ``--write-budgets`` — AOT-lower the registered entries and refresh
+  ``lint/budgets.json`` for the current backend platform, then exit 0;
 * ``--json`` — emit one machine-readable JSON line (the form
   ``make evidence`` embeds in EVIDENCE.json) after the human output.
 
-Paths default to the package + repo entry scripts.  Tests and fixture
-corpora are deliberately NOT linted: the suite runs x64 on purpose, and
-``tests/test_lint.py``'s fixtures must contain violations.
+Paths default to the package + repo entry scripts + examples.  Tests
+and fixture corpora are deliberately NOT linted: the suite runs x64 on
+purpose, and ``tests/test_lint.py``'s fixtures must contain violations.
 """
 from __future__ import annotations
 
@@ -23,7 +27,7 @@ import json
 import os
 import sys
 
-DEFAULT_TARGETS = ("raft_tpu", "__graft_entry__.py", "bench.py")
+DEFAULT_TARGETS = ("raft_tpu", "__graft_entry__.py", "bench.py", "examples")
 
 
 def repo_root() -> str:
@@ -59,6 +63,14 @@ def main(argv=None) -> int:
     ap.add_argument("--no-retrace-check", action="store_true",
                     help="audit jaxpr budgets only (skip the compile the "
                          "retrace check needs)")
+    ap.add_argument("--no-budget-check", action="store_true",
+                    help="skip the compiled-artifact budget audit")
+    ap.add_argument("--budgets", default=None,
+                    help="budgets JSON (default: raft_tpu/lint/"
+                         "budgets.json)")
+    ap.add_argument("--write-budgets", action="store_true",
+                    help="AOT-lower the registered entries and refresh "
+                         "the committed budgets for this platform")
     ap.add_argument("--json", action="store_true",
                     help="emit a final machine-readable JSON line")
     args = ap.parse_args(argv)
@@ -66,6 +78,23 @@ def main(argv=None) -> int:
     root = args.root or repo_root()
     rc = 0
     summary: dict = {"tool": "graftlint"}
+
+    if args.write_budgets:
+        # budget refresh is its own mode: lower + measure, save, done
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        from raft_tpu.lint.audit import write_budgets
+
+        names = (args.audit_entries.split(",")
+                 if args.audit_entries else None)
+        path, reports = write_budgets(names, args.budgets)
+        for r in reports:
+            print(r.summary())
+        print(f"[graftlint] budgets written: {path} "
+              f"({len(reports)} entries)")
+        if args.json:
+            print(json.dumps({"tool": "graftlint", "ok": True,
+                              "budgets_written": len(reports)}))
+        return 0
 
     if not args.audit_only:
         from raft_tpu.lint import baseline as bl
@@ -109,12 +138,23 @@ def main(argv=None) -> int:
         names = (args.audit_entries.split(",")
                  if args.audit_entries else None)
         reports = run_audit(names,
-                            retrace_check=not args.no_retrace_check)
+                            retrace_check=not args.no_retrace_check,
+                            budget_check=not args.no_budget_check,
+                            budgets_path=args.budgets)
         for r in reports:
             print(r.summary())
         bad = [r for r in reports if not r.ok]
         summary["audit"] = {"entries": [r.to_dict() for r in reports],
                             "failed": len(bad)}
+        if not args.no_budget_check:
+            # one-key-deep pass/fail + metrics for EVIDENCE.json
+            summary["budgets"] = {
+                "ok": all(r.budget_ok for r in reports),
+                "entries": {r.name: {"ok": r.budget_ok,
+                                     "metrics": r.metrics,
+                                     "notes": r.budget_notes}
+                            for r in reports},
+            }
         if bad:
             rc = 1
 

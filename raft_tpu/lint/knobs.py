@@ -1,0 +1,200 @@
+"""Machine-readable registry of every environment knob raft_tpu reads.
+
+Each ``RAFT_TPU_*`` / ``JAX_*`` / ``XLA_FLAGS`` read in the package is a
+contract with the warm-start subsystem: a knob that changes the *traced
+program* (kernel routing, donation, padding ladder, backend) MUST be
+folded into the AOT executable keys, or a warm process can silently be
+served an executable compiled under the other setting — exactly the
+lambda-salt cache defeat fixed by hand in PR 2.  A knob that only steers
+*host-side* behavior (schedules, roots, timeouts) must stay out of the
+keys, or flipping it would needlessly recompile.  This registry writes
+that classification down once, machine-readably, and three consumers
+enforce it:
+
+* rule **GL201** (:mod:`raft_tpu.lint.rules`): every matching env read in
+  linted code must name a registered knob, and a read reachable from
+  jit-traced code must be classified ``aot_key``;
+* the **docs table** in ``docs/usage.rst`` is generated from this file
+  (:func:`rst_table`; ``python -m raft_tpu.lint.knobs`` rewrites it
+  between the AUTOGEN markers) — a drift test pins file == registry;
+* a **salt-site test** (``tests/test_lint.py``) asserts each ``aot_key``
+  knob's ``salt_token`` really appears in the source of its declared
+  ``salted_via`` function, so the classification cannot rot into a claim.
+
+Classifications:
+
+``aot_key``
+    The knob changes the traced/compiled program; its resolved value is
+    folded into every AOT executable key (``salted_via`` names the salt
+    function, ``salt_token`` the source fragment carrying the knob).
+``host``
+    Host-side orchestration only (cache roots, schedules, timeouts,
+    strictness): never alters a traced program, never keyed.
+``fault``
+    Deterministic fault injection (:mod:`raft_tpu.resilience.faults`):
+    host-side by contract, exercised only by the resilience harness.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import re
+
+#: env names GL201 (and the drift test) consider knob reads
+ENV_READ_RE = re.compile(r"^(?:RAFT_TPU_[A-Z0-9_]+|JAX_[A-Z0-9_]+|XLA_FLAGS)$")
+
+AOT_KEY = "aot_key"
+HOST = "host"
+FAULT = "fault"
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str            # human-readable default, for the docs table
+    layer: str              # owning subsystem (module that parses it)
+    classification: str     # AOT_KEY / HOST / FAULT
+    description: str        # one line, for the docs table
+    salted_via: str | None = None    # dotted function folding it into keys
+    salt_token: str | None = None    # source fragment proving the salt
+
+
+KNOBS: tuple[Knob, ...] = (
+    # ------------------------------------------------ program-shaping ----
+    Knob("RAFT_TPU_PALLAS", "auto (on iff TPU)", "core.pallas6", AOT_KEY,
+         "Route the batched 6x6 RAO solves through the Pallas kernel",
+         salted_via="raft_tpu.cache.aot._solver_salts",
+         salt_token="pallas6.enabled()"),
+    Knob("RAFT_TPU_DONATE", "on", "parallel.pipeline", AOT_KEY,
+         "Buffer donation at the donating call sites (chunked DLC staging)",
+         salted_via="raft_tpu.cache.aot.donation_salt",
+         salt_token="donate_argnums"),
+    Knob("RAFT_TPU_BUCKETS", "built-in ladder", "build.buckets", AOT_KEY,
+         "Size-class ladder for shape-bucketed mixed-design megabatches",
+         salted_via="raft_tpu.build.buckets.ladder_salt",
+         salt_token="buckets"),
+    Knob("XLA_FLAGS", "unset", "cache.aot", AOT_KEY,
+         "Raw XLA compiler flags (device counts, HLO dumps, ...)",
+         salted_via="raft_tpu.cache.aot._solver_salts",
+         salt_token="XLA_FLAGS"),
+    Knob("JAX_PLATFORMS", "unset (jax default)", "cache.aot", AOT_KEY,
+         "Backend platform pin; keyed via the device topology",
+         salted_via="raft_tpu.cache.aot._topology",
+         salt_token="default_backend()"),
+    # ------------------------------------------------------- host-only ----
+    Knob("RAFT_TPU_CACHE_DIR", "~/.cache/raft_tpu", "cache.config", HOST,
+         "Warm-start cache root; 'off' disables every warm layer"),
+    Knob("RAFT_TPU_CKPT", "off", "resilience.checkpoint", HOST,
+         "Durable chunk checkpoint store ('1' = cache root, or a path)"),
+    Knob("RAFT_TPU_PIPELINE_DEPTH", "2", "parallel.pipeline", HOST,
+         "Dispatch-ahead window of the chunked executor (min 1)"),
+    Knob("RAFT_TPU_STRICT", "on", "resilience.health", HOST,
+         "Fail loud after reporting a degraded bench/sweep result"),
+    Knob("RAFT_TPU_BUILD_TIMEOUT", "300 s", "resilience.retry", HOST,
+         "Hard timeout for the native BEM g++ build subprocess"),
+    Knob("RAFT_TPU_PROBE_TIMEOUT", "60 s", "bench", HOST,
+         "Device probe child timeout in bench.py"),
+    Knob("RAFT_TPU_PROBE_RETRIES", "2", "bench", HOST,
+         "Device probe retry budget in bench.py"),
+    Knob("RAFT_TPU_BENCH_BUDGET", "1500 s", "bench", HOST,
+         "Wall-clock budget bench.py divides between its phases"),
+    Knob("RAFT_TPU_BENCH_ASSUME_DEVICE", "unset", "bench", HOST,
+         "Internal: marks the re-exec'd device bench child"),
+    Knob("RAFT_TPU_DRYRUN_NO_REEXEC", "unset", "__graft_entry__", HOST,
+         "Internal: recursion guard of the dryrun subprocess fallback"),
+    # ------------------------------------------------- fault injection ----
+    Knob("RAFT_TPU_FAULT_INJECT", "unset", "resilience.faults", FAULT,
+         "Deterministic host-side fault specs (nan_chunk:K, kill, ...)"),
+)
+
+_BY_NAME = {k.name: k for k in KNOBS}
+
+
+def get(name: str) -> Knob | None:
+    return _BY_NAME.get(name)
+
+
+def names() -> frozenset:
+    return frozenset(_BY_NAME)
+
+
+def classification(name: str) -> str | None:
+    k = _BY_NAME.get(name)
+    return k.classification if k else None
+
+
+# ------------------------------------------------------------------ docs --
+
+#: markers bounding the generated block in docs/usage.rst
+BEGIN_MARK = ".. BEGIN AUTOGEN KNOB TABLE (python -m raft_tpu.lint.knobs)"
+END_MARK = ".. END AUTOGEN KNOB TABLE"
+
+_AOT_LABEL = {AOT_KEY: "key-salted", HOST: "host-only", FAULT: "fault-inj"}
+
+
+def rst_table() -> str:
+    """The env-knob reference as an RST grid table (list-table), generated
+    so ``docs/usage.rst`` can never drift from the registry."""
+    lines = [
+        ".. list-table:: Environment knobs (generated from "
+        "``raft_tpu/lint/knobs.py``)",
+        "   :header-rows: 1",
+        "   :widths: 28 18 16 12 40",
+        "",
+        "   * - Knob",
+        "     - Default",
+        "     - Layer",
+        "     - AOT key",
+        "     - Effect",
+    ]
+    for k in sorted(KNOBS, key=lambda k: (k.classification != AOT_KEY,
+                                          k.name)):
+        lines += [
+            f"   * - ``{k.name}``",
+            f"     - {k.default}",
+            f"     - ``{k.layer}``",
+            f"     - {_AOT_LABEL[k.classification]}",
+            f"     - {k.description}",
+        ]
+    return "\n".join(lines) + "\n"
+
+
+def _usage_path() -> str:
+    here = os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+    return os.path.join(here, "docs", "usage.rst")
+
+
+def rendered_docs_block(text: str) -> str | None:
+    """The current generated block of ``text`` (between the markers,
+    exclusive), or None when the markers are absent/malformed."""
+    try:
+        head, rest = text.split(BEGIN_MARK, 1)
+        block, _tail = rest.split(END_MARK, 1)
+    except ValueError:
+        return None
+    return block.strip("\n") + "\n"
+
+
+def rewrite_docs(path: str | None = None) -> bool:
+    """Regenerate the table between the markers in ``docs/usage.rst``.
+    Returns True when the file changed."""
+    path = path or _usage_path()
+    with open(path, "r", encoding="utf-8") as f:
+        text = f.read()
+    if BEGIN_MARK not in text or END_MARK not in text:
+        raise RuntimeError(f"AUTOGEN markers missing from {path}")
+    head, rest = text.split(BEGIN_MARK, 1)
+    _old, tail = rest.split(END_MARK, 1)
+    new = head + BEGIN_MARK + "\n\n" + rst_table() + "\n" + END_MARK + tail
+    if new == text:
+        return False
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(new)
+    return True
+
+
+if __name__ == "__main__":
+    changed = rewrite_docs()
+    print(f"[knobs] docs/usage.rst {'updated' if changed else 'up to date'}"
+          f" ({len(KNOBS)} knobs)")

@@ -34,6 +34,27 @@ GL106    host-sync-in-jit      ``.item()``/``.tolist()``/``print``/
 GL107    nondeterministic-     iteration over a ``set`` (or unsorted
          iteration             ``os.listdir``) where the order can feed
                                compiled-program structure or cache keys
+GL201    env-knob-contract     a ``RAFT_TPU_*``/``JAX_*``/``XLA_FLAGS`` env
+                               read that is missing from the knob registry
+                               (``lint/knobs.py``), or that executes inside
+                               jit-traced code without being classified
+                               key-salted (its value bakes into compiled
+                               programs the AOT key cannot distinguish)
+GL202    non-atomic-publish    a direct write to a path under a durable
+                               cache/checkpoint root — artifacts must be
+                               published via tmp + ``os.replace`` so a kill
+                               mid-write never leaves a truncated file a
+                               later run trusts
+GL203    unbounded-subprocess  a subprocess invocation outside
+                               ``resilience.retry.checked_subprocess`` that
+                               carries no hard ``timeout=`` (a hung child
+                               wedges the sweep forever)
+GL204    donation-contract     ``donate_argnums``/``donate_argnames`` on a
+                               bare ``jax.jit`` (invisible to the AOT
+                               registry's donation salt), or donating an
+                               argument index that does not exist at the
+                               call site / a function with no output to
+                               alias
 =======  ====================  ==============================================
 
 Reachability: a function is *jit-reachable* when it is decorated with (or
@@ -60,6 +81,8 @@ import hashlib
 import os
 import re
 
+from raft_tpu.lint import knobs as _knobs
+
 RULES = {
     "GL101": "numpy-on-tracer",
     "GL102": "host-cast-on-tracer",
@@ -68,7 +91,28 @@ RULES = {
     "GL105": "float64-literal",
     "GL106": "host-sync-in-jit",
     "GL107": "nondeterministic-iteration",
+    "GL201": "env-knob-contract",
+    "GL202": "non-atomic-publish",
+    "GL203": "unbounded-subprocess",
+    "GL204": "donation-contract",
 }
+
+# the AOT registry's compile entry points: a function handed to one of
+# these is traced and compiled exactly like a jax.jit target (GL1xx
+# reachability roots), and its donation signature is key-salted
+_CACHED_COMPILE_FNS = {"cached_compile", "cached_callable"}
+
+# functions whose return value names a durable on-disk root (warm-start
+# cache layers, checkpoint store): paths derived from them are published
+# artifacts and fall under the GL202 atomic-publish contract
+_DURABLE_ROOT_FNS = {"subdir", "cache_dir", "resolve_dir", "default_dir",
+                     "root"}
+
+# numpy writers that take a PATH first argument (a file object from the
+# tmp+os.replace idiom is fine and not matched by the taint check)
+_NP_WRITE_FNS = {"savez", "savez_compressed", "save"}
+
+_SUBPROCESS_FNS = {"run", "call", "check_call", "check_output", "Popen"}
 
 # transforms whose function argument is traced with abstract values
 _TRACING_TRANSFORMS = {
@@ -173,8 +217,18 @@ class ModuleInfo:
         self.wide_dtype_names: dict[str, str] = {}
         self.file_suppress: set[str] = set()
         self.line_suppress: dict[int, set[str]] = {}
+        # module-level NAME = "string" constants (resolves the
+        # ``ENV_VAR = "RAFT_TPU_X"; os.environ.get(ENV_VAR)`` spelling)
+        self.str_constants: dict[str, str] = {}
         self._collect_suppressions()
         self._collect_imports()
+        for node in self.tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self.str_constants[t.id] = node.value.value
 
     # -- suppressions ---------------------------------------------------
     def _collect_suppressions(self) -> None:
@@ -296,6 +350,78 @@ class ModuleInfo:
         return (isinstance(func, ast.Attribute) and func.attr == "partial"
                 and isinstance(func.value, ast.Name)
                 and func.value.id in self.functools_aliases)
+
+    def _is_os_environ(self, node: ast.AST) -> bool:
+        return (isinstance(node, ast.Attribute) and node.attr == "environ"
+                and isinstance(node.value, ast.Name)
+                and node.value.id in self.os_aliases)
+
+    def env_read_name(self, node: ast.AST) -> str | None:
+        """The env-var name when ``node`` reads the process environment:
+        ``os.environ.get/setdefault(NAME)``, ``os.getenv(NAME)``, or an
+        ``os.environ[NAME]`` load.  Writes (``os.environ[k] = v``,
+        ``.pop``) are not reads and return None.  The name resolves
+        through string literals AND module-level string constants
+        (``ENV_VAR = "RAFT_TPU_X"; os.environ.get(ENV_VAR)``)."""
+
+        def resolve(a: ast.AST) -> str | None:
+            if isinstance(a, ast.Constant) and isinstance(a.value, str):
+                return a.value
+            if isinstance(a, ast.Name):
+                return self.str_constants.get(a.id)
+            return None
+
+        if isinstance(node, ast.Call):
+            fn = node.func
+            if isinstance(fn, ast.Attribute) and node.args:
+                is_read = (
+                    (fn.attr in ("get", "setdefault")
+                     and self._is_os_environ(fn.value))
+                    or (fn.attr == "getenv"
+                        and isinstance(fn.value, ast.Name)
+                        and fn.value.id in self.os_aliases))
+                if is_read:
+                    return resolve(node.args[0])
+        elif isinstance(node, ast.Subscript) and isinstance(node.ctx,
+                                                            ast.Load):
+            if self._is_os_environ(node.value):
+                return resolve(node.slice)
+        return None
+
+    def subprocess_call(self, call: ast.Call) -> str | None:
+        """The invoked function name when ``call`` launches a subprocess
+        (``subprocess.run/call/check_call/check_output/Popen``, through
+        any import spelling), else None."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _SUBPROCESS_FNS:
+            base = fn.value
+            if isinstance(base, ast.Name):
+                if base.id == "subprocess":
+                    return fn.attr
+                tgt = self.import_map.get(base.id)
+                if tgt is not None and tgt[0] == "subprocess":
+                    return fn.attr
+        elif isinstance(fn, ast.Name):
+            tgt = self.import_map.get(fn.id)
+            if tgt is not None and tgt[0] == "subprocess" \
+                    and (tgt[1] or fn.id) in _SUBPROCESS_FNS:
+                return tgt[1] or fn.id
+        return None
+
+    def cached_compile_call(self, call: ast.Call) -> bool:
+        """True when ``call`` goes through the AOT registry
+        (``cached_compile``/``cached_callable``, attribute or bare-name
+        spelling)."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr in _CACHED_COMPILE_FNS
+        if isinstance(fn, ast.Name):
+            if fn.id in _CACHED_COMPILE_FNS:
+                return True
+            tgt = self.import_map.get(fn.id)
+            return (tgt is not None and tgt[0].startswith("raft_tpu")
+                    and (tgt[1] or fn.id) in _CACHED_COMPILE_FNS)
+        return False
 
 
 def _attr_root(node: ast.Attribute) -> ast.AST:
@@ -507,6 +633,18 @@ class Analyzer:
                     else:
                         for fi in self._funcs_named_in(mod, scope, arg):
                             mark(fi)
+            # functions handed to the AOT registry are traced and compiled
+            # exactly like jax.jit targets: cached_compile(tag, fn, args)
+            # / cached_callable(tag, fn, args) mark ``fn`` jit-reachable
+            for scope, call in self._scoped_calls(mod):
+                if not mod.cached_compile_call(call) or len(call.args) < 2:
+                    continue
+                fn_arg = call.args[1]
+                if isinstance(fn_arg, ast.Lambda):
+                    mark(mod.lambda_infos.get(id(fn_arg)))
+                else:
+                    for fi in self._funcs_named_in(mod, scope, fn_arg):
+                        mark(fi)
             # factory pattern: a nested def returned BY NAME is a closure
             # whose callers typically hand it to a transform
             # (``loss = _make_loss(...); jax.value_and_grad(loss)``) — the
@@ -531,10 +669,16 @@ class Analyzer:
             for callee in self._referenced_functions(fi):
                 mark(callee)
 
-    def _transform_calls(self, mod: ModuleInfo):
-        """(lexically enclosing FuncInfo, Call) for every tracing-transform
-        call in the module."""
-        out: list[tuple[FuncInfo | None, ast.Call]] = []
+    def _scoped_nodes(self, mod: ModuleInfo):
+        """(lexically enclosing FuncInfo, node) for every node in the
+        module — the scope is the function whose body the node sits in
+        (None at module level).  Computed once per module (three
+        consumers: transform roots, cached-compile roots, contract
+        rules); the AST is immutable for the Analyzer's lifetime."""
+        cached = getattr(mod, "_scoped_nodes_cache", None)
+        if cached is not None:
+            return cached
+        out: list[tuple[FuncInfo | None, ast.AST]] = []
 
         def walk(node: ast.AST, scope: FuncInfo | None) -> None:
             for child in ast.iter_child_nodes(node):
@@ -545,13 +689,23 @@ class Analyzer:
                     s = mod.functions.get(prefix + child.name, scope)
                 elif isinstance(child, ast.Lambda):
                     s = mod.lambda_infos.get(id(child), scope)
-                if isinstance(child, ast.Call) and \
-                        mod.transform_of(child.func):
-                    out.append((scope, child))
+                out.append((scope, child))
                 walk(child, s)
 
         walk(mod.tree, None)
+        mod._scoped_nodes_cache = out
         return out
+
+    def _scoped_calls(self, mod: ModuleInfo):
+        """(lexically enclosing FuncInfo, Call) for every call."""
+        return [(scope, n) for scope, n in self._scoped_nodes(mod)
+                if isinstance(n, ast.Call)]
+
+    def _transform_calls(self, mod: ModuleInfo):
+        """(lexically enclosing FuncInfo, Call) for every tracing-transform
+        call in the module."""
+        return [(scope, call) for scope, call in self._scoped_calls(mod)
+                if mod.transform_of(call.func)]
 
     def _funcs_named_in(self, mod: ModuleInfo, scope: FuncInfo | None,
                         expr: ast.AST):
@@ -605,6 +759,7 @@ class Analyzer:
         self.propagate()
         for mod in self.modules.values():
             self._check_module_wide(mod)
+            self._check_contracts(mod)
             for fi in mod.functions.values():
                 if fi.reachable:
                     self._check_traced_function(fi)
@@ -762,6 +917,212 @@ class Analyzer:
             self._emit(mod, "GL107", call, "<module>",
                        "join over a set is order-nondeterministic — "
                        "use sorted(...)")
+
+    # ---- cross-cutting contract rules: GL201, GL202, GL203, GL204 ----
+    def _check_contracts(self, mod: ModuleInfo) -> None:
+        for scope, node in self._scoped_nodes(mod):
+            qual = scope.qualname if scope else "<module>"
+            self._gl201_env_read(mod, scope, node, qual)
+            if isinstance(node, ast.Call):
+                self._gl203_subprocess(mod, node, qual)
+                self._gl204_donation(mod, node, qual)
+        # atomic-publish contract: per function scope + module scope
+        for fi in mod.functions.values():
+            self._gl202_scope(mod, list(self._own_body_walk(fi)),
+                              fi.qualname)
+        self._gl202_scope(mod, list(self._module_level_nodes(mod)),
+                          "<module>")
+
+    def _gl201_env_read(self, mod: ModuleInfo, scope: FuncInfo | None,
+                        node: ast.AST, qual: str) -> None:
+        name = mod.env_read_name(node)
+        if name is None or not _knobs.ENV_READ_RE.match(name):
+            return
+        knob = _knobs.get(name)
+        if knob is None:
+            self._emit(mod, "GL201", node, qual,
+                       f"env knob {name!r} is not registered in "
+                       f"raft_tpu/lint/knobs.py — classify it as "
+                       f"key-salted, host-only, or fault-injection before "
+                       f"reading it (the docs table and the AOT-salt "
+                       f"audit are generated from the registry)")
+        elif scope is not None and scope.reachable \
+                and knob.classification != _knobs.AOT_KEY:
+            self._emit(mod, "GL201", node, qual,
+                       f"env knob {name!r} ({knob.classification}) is "
+                       f"read inside jit-reachable {qual}(): the value "
+                       f"is baked into compiled programs at trace time, "
+                       f"invisible to the AOT executable key — classify "
+                       f"it 'aot_key' with a salted_via site, or hoist "
+                       f"the read out of traced code")
+
+    def _gl203_subprocess(self, mod: ModuleInfo, call: ast.Call,
+                          qual: str) -> None:
+        fname = mod.subprocess_call(call)
+        if fname is None:
+            return
+        if fname == "Popen":
+            self._emit(mod, "GL203", call, qual,
+                       "subprocess.Popen carries no hard timeout — a "
+                       "hung child wedges the run forever; route through "
+                       "resilience.retry.checked_subprocess (or justify "
+                       "the raw handle with a suppression)")
+            return
+        has_timeout = any(
+            kw.arg == "timeout" and not (
+                isinstance(kw.value, ast.Constant)
+                and kw.value.value is None)
+            for kw in call.keywords)
+        if not has_timeout:
+            self._emit(mod, "GL203", call, qual,
+                       f"subprocess.{fname}() without a hard timeout can "
+                       f"hang forever (NFS stall, wedged toolchain) — "
+                       f"use resilience.retry.checked_subprocess or pass "
+                       f"timeout=")
+
+    def _gl204_donation(self, mod: ModuleInfo, call: ast.Call,
+                        qual: str) -> None:
+        donate_kws = [kw for kw in call.keywords
+                      if kw.arg in ("donate_argnums", "donate_argnames")]
+        is_jit = mod.transform_of(call.func) == "jit"
+        is_partial_jit = (mod.is_partial(call.func) and call.args
+                          and mod.transform_of(call.args[0]) == "jit")
+        if donate_kws and (is_jit or is_partial_jit):
+            self._emit(mod, "GL204", call, qual,
+                       "donation on a bare jax.jit is invisible to the "
+                       "AOT registry key: a warm process can be served "
+                       "an executable compiled under the OTHER aliasing "
+                       "contract — route through cache.aot."
+                       "cached_compile/cached_callable(jit_kwargs=...), "
+                       "whose donation_salt folds the signature into "
+                       "every key")
+            return
+        if not mod.cached_compile_call(call):
+            return
+        # at a registry call site, literal donate indices must exist in
+        # the literal args tuple (JAX validates the same-shape/dtype
+        # output alias at compile time; a bad index never gets that far).
+        # args may arrive positionally or as a keyword in ANY order
+        # relative to jit_kwargs, so resolve it before checking
+        args_node = call.args[2] if len(call.args) >= 3 else None
+        if args_node is None:
+            for kw in call.keywords:
+                if kw.arg == "args":
+                    args_node = kw.value
+                    break
+        for kw in call.keywords:
+            if kw.arg != "jit_kwargs" or not isinstance(kw.value, ast.Dict):
+                continue
+            for k, v in zip(kw.value.keys, kw.value.values):
+                if not (isinstance(k, ast.Constant)
+                        and k.value == "donate_argnums"):
+                    continue
+                idxs = [n.value for n in ast.walk(v)
+                        if isinstance(n, ast.Constant)
+                        and isinstance(n.value, int)]
+                if isinstance(args_node, ast.Tuple):
+                    nargs = len(args_node.elts)
+                    for i in idxs:
+                        if i >= nargs or i < -nargs:
+                            self._emit(
+                                mod, "GL204", call, qual,
+                                f"donate_argnums {i} is out of range for "
+                                f"the {nargs}-argument call site — there "
+                                f"is no input buffer to alias")
+
+    def _module_level_nodes(self, mod: ModuleInfo):
+        """Module-scope statements (function/lambda bodies excluded —
+        each is checked in its own scope)."""
+        stack = list(mod.tree.body)
+        while stack:
+            node = stack.pop()
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                continue
+            yield node
+            stack.extend(ast.iter_child_nodes(node))
+
+    def _gl202_scope(self, mod: ModuleInfo, body: list, qual: str) -> None:
+        """Atomic-publish contract for one scope: a write-mode ``open``
+        (or ``np.save*``) whose path derives from a durable root call
+        (``config.subdir``/``cache_dir``/``resolve_dir``/checkpoint
+        ``root``/...) is a torn-artifact hazard; the tmp +
+        ``os.replace`` idiom (``tempfile.mkstemp`` in the same
+        directory) writes through an untainted name and passes."""
+        tainted: set[str] = set()
+
+        def durable(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Call):
+                    fn = n.func
+                    nm = (fn.id if isinstance(fn, ast.Name)
+                          else fn.attr if isinstance(fn, ast.Attribute)
+                          else None)
+                    if nm in _DURABLE_ROOT_FNS:
+                        return True
+                elif isinstance(n, ast.Name) and isinstance(n.ctx,
+                                                            ast.Load) \
+                        and n.id in tainted:
+                    return True
+            return False
+
+        while True:   # fixpoint over chained assignments: body nodes are
+            # in stack-pop (non-source) order, so one pass may propagate
+            # only a single link of a join chain — iterate until stable
+            # (terminates: taint only grows, bounded by the name count)
+            changed = False
+            for node in body:
+                targets: list = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                if value is None or not durable(value):
+                    continue
+                for t in targets:
+                    for nm in _target_names(t):
+                        if nm not in tainted:
+                            tainted.add(nm)
+                            changed = True
+            if not changed:
+                break
+
+        for node in body:
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if isinstance(fn, ast.Name) and fn.id == "open" and node.args:
+                mode = None
+                if len(node.args) >= 2 and isinstance(node.args[1],
+                                                      ast.Constant):
+                    mode = node.args[1].value
+                for kw in node.keywords:
+                    if kw.arg == "mode" and isinstance(kw.value,
+                                                       ast.Constant):
+                        mode = kw.value.value
+                if isinstance(mode, str) and any(c in mode for c in "wax+") \
+                        and durable(node.args[0]):
+                    self._emit(mod, "GL202", node, qual,
+                               f"direct {mode!r}-mode open() on a path "
+                               f"under a durable cache/checkpoint root — "
+                               f"a kill mid-write leaves a truncated "
+                               f"artifact; publish via tempfile.mkstemp "
+                               f"in the same directory + os.replace")
+            elif isinstance(fn, ast.Attribute) and fn.attr in _NP_WRITE_FNS \
+                    and mod.is_numpy(_attr_root(fn)) and node.args \
+                    and durable(node.args[0]):
+                self._emit(mod, "GL202", node, qual,
+                           f"np.{fn.attr}() writes directly to a path "
+                           f"under a durable cache/checkpoint root — a "
+                           f"kill mid-write leaves a truncated artifact "
+                           f"a later np.load would crash on; write to a "
+                           f"tempfile.mkstemp handle and os.replace into "
+                           f"place")
 
     # ---- traced-function rules: GL101, GL102, GL103, GL106 ----
     def _check_traced_function(self, fi: FuncInfo) -> None:
@@ -1023,3 +1384,21 @@ def lint_paths(paths: list[str], root: str) -> list[Violation]:
     """Run every rule over the .py files under ``paths`` (dirs recurse)."""
     files = collect_py_files(paths, root)
     return Analyzer(files, root).run()
+
+
+def collect_env_reads(paths: list[str], root: str) -> dict:
+    """Every ``RAFT_TPU_*``/``JAX_*``/``XLA_FLAGS`` env read under
+    ``paths``: ``{knob name: ["relpath:line", ...]}``.  The knob-registry
+    drift test uses this to pin "every read is registered AND every
+    registered raft knob is actually read" — a registry entry cannot go
+    stale in either direction."""
+    files = collect_py_files(paths, root)
+    a = Analyzer(files, root)
+    out: dict = {}
+    for mod in a.modules.values():
+        for _scope, node in a._scoped_nodes(mod):
+            name = mod.env_read_name(node)
+            if name is not None and _knobs.ENV_READ_RE.match(name):
+                out.setdefault(name, []).append(
+                    f"{mod.relpath}:{getattr(node, 'lineno', 0)}")
+    return out

@@ -341,6 +341,38 @@ def test_cached_compile_keys_on_donation(warm):
     assert not x.is_deleted()
 
 
+def test_compile_events_counted_and_reset(warm):
+    """Real compiles land in both the ordered event log and the exact
+    per-tag counters; reset zeroes them (phase boundaries of long-lived
+    processes)."""
+    aot.reset_compile_events()
+    x = jnp.arange(4.0)
+    aot.cached_compile("evt_a", lambda v: v + 1, (x,))
+    aot.cached_compile("evt_b", lambda v: v * 2, (x,))
+    aot.cached_compile("evt_a", lambda v: v + 1, (x,))     # mem hit: no event
+    assert aot.compile_events("evt_a") == ["evt_a"]
+    assert aot.compile_count("evt_a") == 1
+    assert aot.compile_count() == 2
+    aot.reset_compile_events()
+    assert aot.compile_events() == [] and aot.compile_count() == 0
+
+
+def test_compile_event_log_is_bounded_counters_exact():
+    """The ordered log is a ring (a daemon or multi-phase bench cannot
+    grow it without limit) while compile_count stays exact past the
+    wrap.  Events are injected exactly as cached_compile records them."""
+    aot.reset_compile_events()
+    try:
+        n = aot._COMPILE_EVENTS_MAX + 50
+        for i in range(n):
+            aot._compile_events.append("ring")
+            aot._compile_counts["ring"] += 1
+        assert len(aot.compile_events()) == aot._COMPILE_EVENTS_MAX
+        assert aot.compile_count("ring") == n
+    finally:
+        aot.reset_compile_events()
+
+
 def test_cached_callable_off_is_plain_jit():
     cache.disable()
     x = jnp.ones(4)

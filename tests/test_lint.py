@@ -161,6 +161,75 @@ FIXTURES = {
             return tuple(sorted(set(out)))
         """,
     ),
+    "GL201": (
+        """
+        import os
+
+        def stage(x):
+            return os.environ.get("RAFT_TPU_WIDGET", "1")
+        """,
+        """
+        import os
+
+        def stage(x):
+            # registered host-only knob, read in host-side code: fine
+            return os.environ.get("RAFT_TPU_STRICT", "1")
+        """,
+    ),
+    "GL202": (
+        """
+        import json
+        import os
+        from raft_tpu.cache.config import subdir
+
+        def publish(payload, key):
+            path = os.path.join(subdir("aot"), key + ".json")
+            with open(path, "w") as f:
+                json.dump(payload, f)
+        """,
+        """
+        import json
+        import os
+        import tempfile
+        from raft_tpu.cache.config import subdir
+
+        def publish(payload, key):
+            path = os.path.join(subdir("aot"), key + ".json")
+            fd, tmp = tempfile.mkstemp(dir=os.path.dirname(path))
+            with os.fdopen(fd, "w") as f:
+                json.dump(payload, f)
+            os.replace(tmp, path)
+        """,
+    ),
+    "GL203": (
+        """
+        import subprocess
+
+        def build(cmd):
+            return subprocess.run(cmd, capture_output=True)
+        """,
+        """
+        import subprocess
+
+        def build(cmd):
+            return subprocess.run(cmd, capture_output=True, timeout=300.0)
+        """,
+    ),
+    "GL204": (
+        """
+        import jax
+
+        def make_step(step):
+            return jax.jit(step, donate_argnums=(0,))
+        """,
+        """
+        from raft_tpu.cache.aot import cached_callable
+
+        def make_step(step, x):
+            return cached_callable("step", step, (x,),
+                                   jit_kwargs={"donate_argnums": (0,)})
+        """,
+    ),
 }
 
 
@@ -341,10 +410,192 @@ def test_baseline_round_trip(tmp_path):
 
 
 # --------------------------------------------------------------------------
+# contract rules: reachability through the AOT registry + edge semantics
+# --------------------------------------------------------------------------
+def test_cached_callable_fn_is_jit_reachable(tmp_path):
+    """A function handed to cached_compile/cached_callable is traced and
+    compiled like a jax.jit target — GL1xx rules must see it."""
+    vs = _lint_src(tmp_path, """
+        import numpy as np
+        from raft_tpu.cache.aot import cached_callable
+
+        def orchestrate(x):
+            def one(v):
+                return np.sin(v)
+            return cached_callable("t", one, (x,))(x)
+        """)
+    assert any(v.rule == "GL101" and ".one" in v.msg for v in vs), vs
+
+
+def test_gl201_salted_knob_in_traced_code_ok(tmp_path):
+    """A key-salted knob (RAFT_TPU_PALLAS rides _solver_salts) may be
+    read at trace time: the AOT key distinguishes its settings."""
+    vs = _lint_src(tmp_path, """
+        import os
+        import jax
+
+        @jax.jit
+        def f(x):
+            on = os.environ.get("RAFT_TPU_PALLAS") == "1"
+            return x * (2.0 if on else 1.0)
+        """)
+    assert not any(v.rule == "GL201" for v in vs), vs
+
+
+def test_gl201_host_knob_in_traced_code_flagged(tmp_path):
+    """A host-only knob read inside jit-reachable code bakes its value
+    into compiled programs the AOT key cannot tell apart."""
+    vs = _lint_src(tmp_path, """
+        import os
+        import jax
+
+        @jax.jit
+        def f(x):
+            depth = int(os.environ.get("RAFT_TPU_PIPELINE_DEPTH", "2"))
+            return x * depth
+        """)
+    hits = [v for v in vs if v.rule == "GL201"]
+    assert hits and "jit-reachable" in hits[0].msg, vs
+
+
+def test_gl202_taints_through_join_and_or(tmp_path):
+    """The native_bem shape: root from cache_dir()/resolve_dir(), path
+    through os.path.join chains, then a direct np.savez write."""
+    vs = _lint_src(tmp_path, """
+        import os
+        import numpy as np
+        from raft_tpu.cache import config
+
+        def persist(A):
+            root = config.cache_dir() or config.resolve_dir()
+            base = os.path.join(root, "bem")
+            key = os.path.join(base, "k.npz")
+            np.savez_compressed(key, A=A)
+        """)
+    assert any(v.rule == "GL202" for v in vs), vs
+
+
+def test_gl202_taint_survives_deep_join_chains(tmp_path):
+    """The taint fixpoint runs until stable, not a fixed pass count —
+    body nodes arrive in non-source order, so a long join chain needs
+    as many passes as links."""
+    vs = _lint_src(tmp_path, """
+        import os
+        import numpy as np
+        from raft_tpu.cache import config
+
+        def persist(A):
+            root = config.cache_dir()
+            a = os.path.join(root, 'x')
+            b = os.path.join(a, 'y')
+            c = os.path.join(b, 'z')
+            d = os.path.join(c, 'w')
+            np.savez_compressed(d, A=A)
+        """)
+    assert any(v.rule == "GL202" for v in vs), vs
+
+
+def test_gl203_popen_always_flagged(tmp_path):
+    vs = _lint_src(tmp_path, """
+        import subprocess
+
+        def spawn(cmd):
+            return subprocess.Popen(cmd)
+        """)
+    assert any(v.rule == "GL203" and "Popen" in v.msg for v in vs), vs
+
+
+def test_gl204_out_of_range_donation_at_registry_site(tmp_path):
+    vs = _lint_src(tmp_path, """
+        from raft_tpu.cache.aot import cached_callable
+
+        def make(fn, x):
+            return cached_callable("t", fn, (x,),
+                                   jit_kwargs={"donate_argnums": (3,)})
+        """)
+    assert any(v.rule == "GL204" and "out of range" in v.msg
+               for v in vs), vs
+
+
+def test_gl204_keyword_args_after_jit_kwargs(tmp_path):
+    """args= resolved regardless of keyword order relative to
+    jit_kwargs= (a lexical-order dependence was a false negative)."""
+    vs = _lint_src(tmp_path, """
+        from raft_tpu.cache.aot import cached_compile
+
+        def make(fn, x):
+            return cached_compile("t", fn,
+                                  jit_kwargs={"donate_argnums": (3,)},
+                                  args=(x,))
+        """)
+    assert any(v.rule == "GL204" and "out of range" in v.msg
+               for v in vs), vs
+
+
+# --------------------------------------------------------------------------
+# knob registry: env-read coverage + salt sites + docs table drift
+# --------------------------------------------------------------------------
+def test_every_env_read_is_registered():
+    """Adding an env knob without a registry entry (or keeping a zombie
+    entry no code reads) fails here — the docs table and GL201 both
+    build on the registry being exact."""
+    from raft_tpu.lint import knobs
+    from raft_tpu.lint.rules import collect_env_reads
+
+    reads = collect_env_reads(
+        ["raft_tpu", "__graft_entry__.py", "bench.py", "examples"], REPO)
+    unregistered = set(reads) - knobs.names()
+    assert not unregistered, (
+        f"env knobs read but not registered in lint/knobs.py: "
+        f"{ {k: reads[k] for k in sorted(unregistered)} }")
+    zombies = {k.name for k in knobs.KNOBS
+               if k.name.startswith("RAFT_TPU_")} - set(reads)
+    assert not zombies, (f"registered knobs no code reads any more "
+                         f"(delete them): {sorted(zombies)}")
+
+
+def test_aot_key_knobs_have_live_salt_sites():
+    """Each key-salted knob declares the function folding it into the
+    AOT keys; that function must exist and its source must carry the
+    declared token — the classification cannot rot into a claim."""
+    import importlib
+    import inspect
+
+    from raft_tpu.lint import knobs
+
+    for k in knobs.KNOBS:
+        if k.classification != knobs.AOT_KEY:
+            assert k.salted_via is None, k
+            continue
+        assert k.salted_via and k.salt_token, k
+        mod_name, fn_name = k.salted_via.rsplit(".", 1)
+        fn = getattr(importlib.import_module(mod_name), fn_name)
+        src = inspect.getsource(fn)
+        assert k.salt_token in src, (
+            f"{k.name}: salt site {k.salted_via} no longer mentions "
+            f"{k.salt_token!r}")
+
+
+def test_docs_knob_table_in_sync():
+    """docs/usage.rst's generated block == the registry's rendering
+    (regenerate with `python -m raft_tpu.lint.knobs`)."""
+    from raft_tpu.lint import knobs
+
+    text = open(os.path.join(REPO, "docs", "usage.rst"),
+                encoding="utf-8").read()
+    block = knobs.rendered_docs_block(text)
+    assert block is not None, "AUTOGEN markers missing from docs/usage.rst"
+    assert block.strip() == knobs.rst_table().strip(), (
+        "docs/usage.rst knob table is stale — run "
+        "`python -m raft_tpu.lint.knobs`")
+
+
+# --------------------------------------------------------------------------
 # repo gate: the merged tree stays clean (fails `make fast` on regression)
 # --------------------------------------------------------------------------
 def test_repo_is_lint_clean_vs_baseline():
-    vs = lint_paths(["raft_tpu", "__graft_entry__.py", "bench.py"], REPO)
+    vs = lint_paths(["raft_tpu", "__graft_entry__.py", "bench.py",
+                     "examples"], REPO)
     fresh, _ = bl.filter_new(vs)
     assert fresh == [], "NEW lint violations:\n" + "\n".join(
         v.format() for v in fresh)
@@ -444,3 +695,121 @@ def test_rules_catalog_documented():
     docs = open(os.path.join(REPO, "docs", "lint.rst")).read()
     for rule in RULES:
         assert rule in docs, f"{rule} missing from docs/lint.rst"
+
+
+# --------------------------------------------------------------------------
+# compiled-artifact budget audit
+# --------------------------------------------------------------------------
+def _committed_budgets():
+    from raft_tpu.lint import audit
+
+    return audit.load_budgets()
+
+
+def test_repo_budgets_cover_every_registered_entry():
+    """Acceptance gate: all registered audit entries carry committed CPU
+    budgets (registering an entry without budgeting it is half a gate)."""
+    from raft_tpu.lint.registry import ENTRY_POINTS
+
+    plat = _committed_budgets()["platforms"].get("cpu", {})
+    missing = {e.name for e in ENTRY_POINTS} - set(plat)
+    assert not missing, (f"registered entries without committed budgets "
+                         f"(run `make lint-budgets`): {sorted(missing)}")
+    for name, b in plat.items():
+        metrics = [k for k in b if not k.startswith("_")]
+        assert {"n_eqns", "flops", "bytes_accessed"} <= set(metrics), (
+            name, metrics)
+
+
+def test_budget_check_passes_within_tolerance():
+    from raft_tpu.lint.audit import check_budget
+
+    budgets = {"tolerance": 0.25,
+               "platforms": {"cpu": {"e": {"flops": 1000.0,
+                                           "n_eqns": 100}}}}
+    ok, notes = check_budget("e", {"flops": 1100.0, "n_eqns": 100},
+                             budgets, "cpu")
+    assert ok, notes
+
+
+def test_budget_check_fails_on_perturbed_budget():
+    """The acceptance fixture: perturb a stored budget downward (so the
+    unchanged program now reads as a regression) and the audit must fail
+    loud, naming the metric."""
+    from raft_tpu.lint.audit import check_budget
+
+    metrics = {"flops": 1000.0, "n_eqns": 100}
+    perturbed = {"tolerance": 0.25,
+                 "platforms": {"cpu": {"e": {"flops": 500.0,
+                                             "n_eqns": 100}}}}
+    ok, notes = check_budget("e", metrics, perturbed, "cpu")
+    assert not ok
+    assert any("flops" in n and "exceeds budget" in n for n in notes), notes
+
+
+def test_budget_check_fails_on_missing_budget_and_metric():
+    from raft_tpu.lint.audit import check_budget
+
+    budgets = {"tolerance": 0.25, "platforms": {"cpu": {}}}
+    ok, notes = check_budget("e", {"flops": 1.0}, budgets, "cpu")
+    assert not ok and "no committed budget" in notes[0]
+    budgets = {"tolerance": 0.25,
+               "platforms": {"cpu": {"e": {"temp_bytes": 64}}}}
+    ok, notes = check_budget("e", {"flops": 1.0}, budgets, "cpu")
+    assert not ok and any("unavailable" in n for n in notes), notes
+
+
+def test_budget_improvement_is_note_not_failure():
+    from raft_tpu.lint.audit import check_budget
+
+    budgets = {"tolerance": 0.25,
+               "platforms": {"cpu": {"e": {"flops": 1000.0}}}}
+    ok, notes = check_budget("e", {"flops": 100.0}, budgets, "cpu")
+    assert ok and any("below budget" in n for n in notes), notes
+
+
+def test_write_budgets_preserves_tolerance_overrides(tmp_path):
+    """A --write-budgets refresh replaces measured values only: the
+    per-entry '_tolerance' override is maintainer state and survives."""
+    import json
+
+    from raft_tpu.lint.audit import AuditReport, save_budgets
+
+    path = str(tmp_path / "budgets.json")
+    json.dump({"tolerance": 0.25,
+               "platforms": {"cpu": {"e": {"flops": 10.0,
+                                           "_tolerance": 0.5}}}},
+              open(path, "w"))
+    r = AuditReport(name="e", public_api="x", n_eqns=1, f64_leaves=0,
+                    f64_examples=[], host_callbacks=0, retraces=0,
+                    trace_s=0.0, ok=True, metrics={"flops": 20.0})
+    save_budgets([r], path, platform="cpu")
+    saved = json.load(open(path))["platforms"]["cpu"]["e"]
+    assert saved == {"flops": 20.0, "_tolerance": 0.5}
+
+
+def test_budget_audit_integration_vs_committed():
+    """One real AOT lowering: the cheapest registered entry's measured
+    metrics must satisfy its committed CPU budget (the same check `make
+    lint` gates on), and a 2x-tightened copy must fail rc-style."""
+    import copy
+
+    import jax
+
+    from raft_tpu.lint.audit import audit_entry, check_budget
+    from raft_tpu.lint.registry import get_entries
+
+    if jax.default_backend() != "cpu":  # pragma: no cover - HW CI
+        pytest.skip("budgets committed for the CPU lowering")
+    (entry,) = get_entries(["dlc_solve"])
+    r = audit_entry(entry, retrace_check=False, collect_metrics=True)
+    assert r.metrics and r.metrics["flops"] > 0
+    budgets = _committed_budgets()
+    ok, notes = check_budget("dlc_solve", r.metrics, budgets, "cpu")
+    assert ok, notes
+    tight = copy.deepcopy(budgets)
+    for k, v in tight["platforms"]["cpu"]["dlc_solve"].items():
+        if not k.startswith("_"):
+            tight["platforms"]["cpu"]["dlc_solve"][k] = v * 0.4
+    ok2, notes2 = check_budget("dlc_solve", r.metrics, tight, "cpu")
+    assert not ok2 and notes2

@@ -58,6 +58,35 @@ def test_deep_sphere_added_mass():
     assert A[0, 4, 0] == pytest.approx(A[4, 0, 0], abs=0.02 * rhoV)
 
 
+def test_result_cache_atomic_and_corruption_tolerant(tmp_path,
+                                                     monkeypatch):
+    """The panel-solver result cache is published atomically and a
+    truncated/garbage artifact is a MISS (deleted, recomputed) — it used
+    to be a direct np.savez whose torn file crashed every later run with
+    the same geometry (GL202)."""
+    from raft_tpu.cache import config
+
+    monkeypatch.setenv("RAFT_TPU_CACHE_DIR", str(tmp_path))
+    config.disable()                       # force env re-resolution
+    p = sphere_mesh(nth=6, naz=10)         # tiny: sub-second solve
+    w = np.array([1.0])
+    A1, B1, F1 = solve_bem(p, w, rho=1000.0, g=9.81, cache=True)
+    bem_dir = os.path.join(str(tmp_path), "bem")
+    (art,) = os.listdir(bem_dir)
+    path = os.path.join(bem_dir, art)
+    assert not art.endswith(".tmp")        # atomic publish left no tmp
+    # served from cache: bit-identical
+    A2, _, _ = solve_bem(p, w, rho=1000.0, g=9.81, cache=True)
+    np.testing.assert_array_equal(A1, A2)
+    # corrupt it: recompute (never crash, never serve garbage), re-publish
+    with open(path, "wb") as f:
+        f.write(b"\x00not-an-npz")
+    A3, _, _ = solve_bem(p, w, rho=1000.0, g=9.81, cache=True)
+    np.testing.assert_allclose(A3, A1, rtol=1e-12)
+    with np.load(path) as z:               # rewritten artifact is whole
+        np.testing.assert_allclose(z["A"], A1, rtol=1e-12)
+
+
 @pytest.mark.slow
 def test_model_with_native_bem_runs():
     from raft_tpu.model import Model, load_design

@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke lint lint-budgets
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke lint lint-budgets
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -24,6 +24,9 @@ resilience-smoke:  ## kill/resume + NaN-quarantine + ladder-salvage proof (CPU, 
 
 hetero-smoke:    ## shape-bucket proof: mixed OC3+VolturnUS+OC4 stream compiles
 	python -m raft_tpu.build.smoke   # once per BUCKET (< designs), cross-process
+
+obs-smoke:       ## observability proof: RAFT_TPU_OBS-armed sweep emits valid
+	python -m raft_tpu.obs           # JSONL + Chrome trace + p50/p99, bounded overhead
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

@@ -921,6 +921,7 @@ def main():
     ns_kw = {} if not fallback else {"batch": 100, "chunk": 50, "reps": 1}
     oc3_kw = {} if not fallback else {"batch": 128, "reps": 1}
     try:
+        from raft_tpu import obs as _obs
         from raft_tpu.utils import profiling as prof
 
         with prof.phase("setup_bem_stage"):
@@ -968,7 +969,12 @@ def main():
                 "volturn_bem": round(base_v, 1),
                 "oc3_strip": round(base_o, 1),
             },
-            "phases_s": {k: round(v, 3) for k, v in prof.totals().items()},
+            # unified observability block (raft_tpu.obs): the span
+            # roll-up supersedes the bespoke phases_s dict (same nested
+            # names, now with call counts), plus the full metric
+            # snapshot (latency histogram quantiles included) and the
+            # exact per-tag compile counts
+            "obs": _obs.obs_block(),
             # cold/warm split: cache hit/miss counts + saved seconds per
             # layer — a warm process shows aot disk_hits / staging hits
             # with north_star/compile + setup_bem_stage collapsed
@@ -999,6 +1005,10 @@ def main():
                 out = dev_out
             else:
                 out["tpu_retry"] = retry_err
+        # with RAFT_TPU_OBS armed, the bench additionally leaves the
+        # JSONL event log + Chrome trace + Prometheus snapshot behind
+        # (no-op when the knob is off — the default)
+        _obs.maybe_publish("bench")
         print(json.dumps(out))
     except Exception as e:  # emit a diagnostic line, not a stack trace
         # (a child with ASSUME_DEVICE lands here on a mid-bench device

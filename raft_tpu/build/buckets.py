@@ -162,6 +162,9 @@ def _record_promotion(n: int = 1) -> None:
     global _promotions
     with _lock:
         _promotions += n
+    from raft_tpu import obs as _obs
+
+    _obs.metrics.counter("buckets.promotions").inc(n)
 
 
 def reset_promotions() -> None:

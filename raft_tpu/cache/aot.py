@@ -85,6 +85,13 @@ def compile_count(tag: str | None = None) -> int:
     return _compile_counts.get(tag, 0)
 
 
+def compile_counts() -> dict:
+    """Exact {tag: real compiles} since process start (or the last
+    :func:`reset_compile_events`) — the per-tag form of
+    :func:`compile_count`, e.g. for the ``obs`` bench block."""
+    return dict(_compile_counts)
+
+
 def reset_compile_events() -> None:
     """Zero the compile-event log AND counters — phase boundaries of
     long-lived processes (bench passes, a resident solver service)
@@ -295,6 +302,9 @@ def _try_load(key: str):
             loaded = se.deserialize_and_load(payload, in_tree, out_tree)
         load_s = time.perf_counter() - t0
         stats.record("aot", "disk_hit", saved_s=max(0.0, cold_s - load_s))
+        from raft_tpu import obs as _obs
+
+        _obs.metrics.histogram("aot.deserialize_s").observe(load_s)
         return loaded
     except Exception:
         stats.record("aot", "error")
@@ -368,6 +378,9 @@ def cached_compile(tag: str, fn, args, *, consts=(), mesh=None,
         compiled = jax.jit(fn, **kw).lower(*args).compile()
     cold_s = time.perf_counter() - t0
     stats.record("aot", "miss")
+    from raft_tpu import obs as _obs
+
+    _obs.metrics.histogram("aot.compile_s").observe(cold_s)
     _compile_events.append(tag)
     _compile_counts[tag] += 1
     _try_store(key, compiled, cold_s)

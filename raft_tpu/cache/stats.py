@@ -39,6 +39,11 @@ def record(layer: str, event: str, saved_s: float = 0.0) -> None:
         c = _layers[layer]
         c[_EVENT_KEY[event]] += 1
         c["saved_s"] += float(saved_s)
+    # mirror into the unified metric registry (raft_tpu.obs): one central
+    # site covers every cache layer's hit/miss/error counters
+    from raft_tpu import obs as _obs
+
+    _obs.metrics.counter(f"cache.{layer}.{event}").inc()
 
 
 def report() -> dict:

@@ -135,6 +135,13 @@ def main():
         bb = bench_json.get("workloads", {}).get("hetero_buckets")
         if bb is not None:
             bench["buckets"] = bb
+        # unified observability block (raft_tpu.obs): span roll-up +
+        # metric snapshot with latency histogram quantiles + per-tag
+        # compile counts — the measured-telemetry story one key deep
+        # (supersedes the bespoke phases_s dict)
+        ob = bench_json.get("obs")
+        if ob is not None:
+            bench["obs"] = ob
     else:
         bench["ok"] = False
         bench["error"] = "no JSON line found on bench stdout"

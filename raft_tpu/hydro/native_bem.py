@@ -19,6 +19,7 @@ from __future__ import annotations
 import ctypes
 import hashlib
 import os
+import time
 
 import numpy as np
 
@@ -54,14 +55,17 @@ def _build_lib() -> str:
         _SRC, "-o", tmp, "-lm",
     ]
     timeout_s = _retry.build_timeout_s()
+    from raft_tpu.obs import trace as _trace
+
     try:
-        _retry.retry_call(
-            lambda attempt: _retry.checked_subprocess(
-                cmd, timeout_s=timeout_s, describe="BEM solver g++ build"),
-            retries=2, backoff_s=2.0,
-            retry_on=(_retry.SubprocessFailed,),
-            describe="BEM solver build",
-        )
+        with _trace.span("bem/build_lib"):
+            _retry.retry_call(
+                lambda attempt: _retry.checked_subprocess(
+                    cmd, timeout_s=timeout_s, describe="BEM solver g++ build"),
+                retries=2, backoff_s=2.0,
+                retry_on=(_retry.SubprocessFailed,),
+                describe="BEM solver build",
+            )
         os.replace(tmp, _LIB)
     except _retry.RetryExhausted as e:
         last = e.last
@@ -186,6 +190,8 @@ def solve_bem(
     n_p, n_w, n_b = len(panels), len(w), len(betas)
     depth = float(depth) if depth and depth > 0 else -1.0
 
+    from raft_tpu import obs as _obs
+
     key = None
     if cache:
         h = hashlib.sha256()
@@ -222,8 +228,9 @@ def solve_bem(
                     out = (z["A"], z["B"],
                            z["F"][0] if scalar_beta else z["F"])
                     if haskind:
-                        return out + ((z["Fh"][0] if scalar_beta
-                                       else z["Fh"]),)
+                        out = out + ((z["Fh"][0] if scalar_beta
+                                      else z["Fh"]),)
+                    _obs.metrics.counter("bem.cache_hit").inc()
                     return out
             except Exception:
                 try:
@@ -231,6 +238,8 @@ def solve_bem(
                 except OSError:
                     pass
 
+    if cache and key is not None:
+        _obs.metrics.counter("bem.cache_miss").inc()
     lib = _load()
     A = np.zeros((n_w, 6, 6))
     B = np.zeros((n_w, 6, 6))
@@ -241,12 +250,16 @@ def solve_bem(
     dptr = lambda a: (
         a.ctypes.data_as(ctypes.POINTER(ctypes.c_double)) if a is not None else None
     )
-    ret = lib.bem_solve_mh(
-        dptr(panels), n_p, dptr(w), n_w, depth, rho, g,
-        dptr(betas), n_b,
-        dptr(A), dptr(B), dptr(Fre), dptr(Fim),
-        dptr(Fhre), dptr(Fhim), nthreads, n_lid,
-    )
+    t0 = time.perf_counter()
+    with _obs.trace.span("bem/solve", attrs={"panels": n_p, "nw": n_w,
+                                             "headings": n_b}):
+        ret = lib.bem_solve_mh(
+            dptr(panels), n_p, dptr(w), n_w, depth, rho, g,
+            dptr(betas), n_b,
+            dptr(A), dptr(B), dptr(Fre), dptr(Fim),
+            dptr(Fhre), dptr(Fhim), nthreads, n_lid,
+        )
+    _obs.metrics.histogram("bem.solve_s").observe(time.perf_counter() - t0)
     if ret != 0:
         raise RuntimeError(f"bem_solve failed with code {ret}")
     A = A.transpose(1, 2, 0)

@@ -86,6 +86,8 @@ KNOBS: tuple[Knob, ...] = (
          "Warm-start cache root; 'off' disables every warm layer"),
     Knob("RAFT_TPU_CKPT", "off", "resilience.checkpoint", HOST,
          "Durable chunk checkpoint store ('1' = cache root, or a path)"),
+    Knob("RAFT_TPU_OBS", "off", "obs.export", HOST,
+         "Observability export sink ('1' = cache root obs/, or a directory)"),
     Knob("RAFT_TPU_PIPELINE_DEPTH", "2", "parallel.pipeline", HOST,
          "Dispatch-ahead window of the chunked executor (min 1)"),
     Knob("RAFT_TPU_STRICT", "on", "resilience.health", HOST,

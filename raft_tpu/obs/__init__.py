@@ -1,0 +1,31 @@
+"""Unified observability layer: spans, bounded metrics, exporters.
+
+One subsystem replaces the scattered ad-hoc telemetry (module-global
+phase timers, pipeline stats dicts, compile-event rings, health
+summaries) with a shared schema and export path:
+
+* :mod:`raft_tpu.obs.trace` — thread-safe nested span tracing with a
+  Chrome trace-event exporter (Perfetto-loadable);
+* :mod:`raft_tpu.obs.metrics` — process-wide counters, gauges, and
+  log-bucket latency histograms with deterministic quantiles;
+* :mod:`raft_tpu.obs.export` — sinks armed by ``RAFT_TPU_OBS`` (JSONL
+  event log, Chrome trace file, Prometheus text) plus the ``obs`` block
+  bench JSON / EVIDENCE.json embed.
+
+Everything here is host-side and bounded in memory; arming or reading
+it can never change a traced program, an AOT key, or a compiled
+artifact.  ``make obs-smoke`` proves the end-to-end story cross-process
+(valid exports, quantiles present, bounded overhead).
+"""
+from raft_tpu.obs import export, metrics, trace                   # noqa: F401
+from raft_tpu.obs.export import (                                 # noqa: F401
+    enabled, maybe_publish, obs_block, prometheus_text, publish, read_jsonl,
+)
+from raft_tpu.obs.metrics import counter, gauge, histogram, snapshot  # noqa: F401
+from raft_tpu.obs.trace import chrome_trace, span                 # noqa: F401
+
+
+def reset() -> None:
+    """Clear spans AND metrics (tests, phase boundaries of a daemon)."""
+    trace.reset()
+    metrics.reset()

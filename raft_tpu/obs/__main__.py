@@ -1,0 +1,5 @@
+"""``python -m raft_tpu.obs`` — the observability smoke (see smoke.py)."""
+from raft_tpu.obs.smoke import main
+
+if __name__ == "__main__":
+    raise SystemExit(main())

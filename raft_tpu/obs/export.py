@@ -1,0 +1,200 @@
+"""Observability sinks: JSONL event log, Chrome trace file, Prometheus
+text exposition, and the ``obs`` block for bench JSON / EVIDENCE.json.
+
+Armed by the ``RAFT_TPU_OBS`` env knob (registered host-only in
+``lint/knobs.py``): unset/``off`` disables everything — the default, and
+the fast path writes NOTHING; ``1``/``on`` roots the sink directory
+under the warm-start cache root's ``obs/``; any other value is the sink
+directory itself.  Host-side by contract: arming the knob can never
+change a traced program, an AOT key, or a compiled artifact.
+
+Publishing is ATOMIC (tmp + ``os.replace``, the GL202 contract shared
+with the staging cache and the chunk store): a kill mid-publish leaves
+either the previous complete file or nothing — never a torn artifact.
+Reading is corruption-tolerant anyway (:func:`read_jsonl` skips
+undecodable lines and reports how many, the ``ChunkStore`` precedent),
+so even a log produced by a foreign writer that appends non-atomically
+stays loadable after a mid-write kill.
+
+File layout under the sink directory (pid-suffixed so concurrent
+processes never clobber each other)::
+
+    obs-<label>-<pid>.jsonl      one JSON object per line: a meta header,
+                                 every completed span, one metric snapshot
+    trace-<label>-<pid>.json     Chrome trace-event JSON (open in Perfetto)
+    metrics-<label>-<pid>.prom   Prometheus text exposition
+"""
+from __future__ import annotations
+
+import json
+import os
+import tempfile
+import time
+
+from raft_tpu.obs import metrics as _metrics
+from raft_tpu.obs import trace as _trace
+
+_OFF = ("", "off", "0", "none", "disabled", "false", "no")
+
+
+def root() -> str | None:
+    """The sink directory this process would publish under, or None when
+    ``RAFT_TPU_OBS`` is off (the default)."""
+    v = os.environ.get("RAFT_TPU_OBS", "").strip()
+    if v.lower() in _OFF:
+        return None
+    if v.lower() in ("1", "on", "true", "yes"):
+        from raft_tpu.cache import config
+
+        base = (config.cache_dir() or config.resolve_dir()
+                or config.default_dir())
+        return os.path.join(base, "obs")
+    return os.path.abspath(os.path.expanduser(v))
+
+
+def enabled() -> bool:
+    return root() is not None
+
+
+def _atomic_write(path: str, text: str) -> None:
+    """tmp + ``os.replace`` publish (GL202: no torn artifact under a
+    durable root, ever)."""
+    d = os.path.dirname(path)
+    fd, tmp = tempfile.mkstemp(dir=d, suffix=".tmp")
+    try:
+        with os.fdopen(fd, "w", encoding="utf-8") as f:
+            f.write(text)
+        os.replace(tmp, path)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
+
+
+def _jsonl_lines(label: str) -> list:
+    lines = [json.dumps({
+        "type": "meta", "label": label, "pid": os.getpid(),
+        "schema": 1, "unix_time": time.time(),
+    })]
+    for s in _trace.spans():
+        lines.append(json.dumps({
+            "type": "span", "name": s.name, "ts_us": s.t0_us,
+            "dur_us": s.dur_us, "tid": s.tid, "depth": s.depth,
+            **({"attrs": dict(s.attrs)} if s.attrs else {}),
+        }))
+    lines.append(json.dumps({"type": "metrics", **_metrics.snapshot()}))
+    return lines
+
+
+def publish(label: str = "run", directory: str | None = None) -> dict:
+    """Write the three sink files for this process's current span ring +
+    metric snapshot.  ``directory`` overrides the env-resolved root
+    (tests); raises when neither resolves.  Returns the paths written."""
+    d = directory or root()
+    if d is None:
+        raise RuntimeError(
+            "obs export is not armed: set RAFT_TPU_OBS (1 = cache root, "
+            "or a directory) or pass directory=")
+    os.makedirs(d, exist_ok=True)
+    tag = f"{label}-{os.getpid()}"
+    paths = {
+        "jsonl": os.path.join(d, f"obs-{tag}.jsonl"),
+        "chrome_trace": os.path.join(d, f"trace-{tag}.json"),
+        "prom": os.path.join(d, f"metrics-{tag}.prom"),
+    }
+    _atomic_write(paths["jsonl"], "\n".join(_jsonl_lines(label)) + "\n")
+    _atomic_write(paths["chrome_trace"], json.dumps(_trace.chrome_trace()))
+    _atomic_write(paths["prom"], prometheus_text())
+    return paths
+
+
+def maybe_publish(label: str = "run") -> dict | None:
+    """:func:`publish` when armed, no-op (None) otherwise — the call the
+    instrumented entry points (bench, sweeps, smokes) make
+    unconditionally.  Never raises: a full disk must degrade the
+    telemetry, not the solve."""
+    if not enabled():
+        return None
+    try:
+        return publish(label)
+    except OSError:  # pragma: no cover - disk full / permissions
+        return None
+
+
+def read_jsonl(path: str) -> tuple:
+    """Parse a JSONL event log, skipping corrupt lines (a mid-write kill
+    by a non-atomic foreign writer truncates the tail; the valid prefix
+    must stay loadable — the ``ChunkStore`` corruption-tolerance rule).
+    Returns ``(events, n_corrupt)``."""
+    events, corrupt = [], 0
+    with open(path, "r", encoding="utf-8") as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                ev = json.loads(line)
+            except json.JSONDecodeError:
+                corrupt += 1
+                continue
+            if isinstance(ev, dict):
+                events.append(ev)
+            else:
+                corrupt += 1
+    return events, corrupt
+
+
+# ------------------------------------------------------- Prometheus ----
+
+def _prom_name(name: str) -> str:
+    out = []
+    for ch in "raft_tpu_" + name:
+        out.append(ch if (ch.isalnum() or ch in "_:") else "_")
+    return "".join(out)
+
+
+def prometheus_text() -> str:
+    """The metric snapshot as a Prometheus text exposition (counters,
+    gauges, and histograms with cumulative ``_bucket{le=...}`` series —
+    the standard scrape format, also consumable by a file exporter)."""
+    snap = _metrics.snapshot()
+    lines = []
+    for name, v in snap["counters"].items():
+        pn = _prom_name(name)
+        lines += [f"# TYPE {pn} counter", f"{pn} {v}"]
+    for name, v in snap["gauges"].items():
+        pn = _prom_name(name)
+        lines += [f"# TYPE {pn} gauge", f"{pn} {v}"]
+    for name, h in snap["histograms"].items():
+        pn = _prom_name(name)
+        lines.append(f"# TYPE {pn} histogram")
+        cum = 0
+        for edge, n in h["buckets"]:
+            cum += n
+            le = "+Inf" if edge == "+Inf" else repr(float(edge))
+            lines.append(f'{pn}_bucket{{le="{le}"}} {cum}')
+        if not h["buckets"] or h["buckets"][-1][0] != "+Inf":
+            lines.append(f'{pn}_bucket{{le="+Inf"}} {h["count"]}')
+        lines += [f"{pn}_sum {h['sum_s']}", f"{pn}_count {h['count']}"]
+    return "\n".join(lines) + "\n"
+
+
+# ------------------------------------------------ bench / EVIDENCE ----
+
+def obs_block() -> dict:
+    """The ``obs`` block for bench JSON / EVIDENCE.json: the span
+    roll-up (the successor of the bespoke ``phases_s`` dict — same
+    nested names, now with call counts), the full metric snapshot
+    (histogram quantiles included), and the exact per-tag compile
+    counts from the AOT registry.  JSON-safe by construction."""
+    from raft_tpu.cache import aot
+
+    snap = _metrics.snapshot()
+    return {
+        "spans": _trace.rollup(),
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "histograms": snap["histograms"],
+        **({"dropped_names": snap["dropped_names"]}
+           if "dropped_names" in snap else {}),
+        "compiles": aot.compile_counts(),
+    }

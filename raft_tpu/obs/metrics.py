@@ -1,0 +1,211 @@
+"""Process-wide metric registry: counters, gauges, and log-bucket
+latency histograms — bounded, deterministic, host-side only.
+
+Every hot path feeds the same registry (``counter("aot.disk_hit")``,
+``histogram("pipeline.fetch_s")``, ...), so a daemon, the bench, or the
+future autotuner read ONE coherent snapshot instead of scraping
+scattered stats dicts.  Three deliberate properties:
+
+* **Bounded memory** (the ``compile_events`` ring precedent): a
+  histogram is a FIXED array of log-spaced bucket counts (no reservoir,
+  no per-observation storage), and the registry caps distinct metric
+  names at :data:`_MAX_METRICS` — excess registrations share one
+  overflow instance per kind and are counted in ``dropped_names``, so a
+  name-cardinality bug degrades a metric, never the process.
+* **Deterministic quantiles**: p50/p90/p99 are computed from bucket
+  counts alone (rank-walk to a bucket's UPPER edge), so a test can
+  hand-build counts and assert the exact quantile — no wall-clock
+  randomness.  Values past the top edge saturate to it (quantiles stay
+  finite and JSON-safe); the saturation is visible in the overflow
+  bucket count.
+* **Thread safety**: one module lock guards registration and updates —
+  the increments are far off any per-sample hot loop (per chunk / per
+  bucket / per cache event, not per lane).
+"""
+from __future__ import annotations
+
+import math
+import threading
+from bisect import bisect_left
+
+#: registry cap on distinct metric names (bounded-memory contract)
+_MAX_METRICS = 1024
+
+#: histogram bucket edges: log-spaced, 5 per decade, 1 µs .. 1000 s —
+#: wide enough for a span of anything from a device dispatch to a cold
+#: BEM stage, coarse enough (±26%) to stay 46 numbers total
+_PER_DECADE = 5
+_EDGES: tuple = tuple(
+    10.0 ** (-6 + i / _PER_DECADE) for i in range(9 * _PER_DECADE + 1)
+)
+
+_lock = threading.Lock()
+_metrics: dict = {}              # name -> Counter | Gauge | Histogram
+_dropped: list = [0]             # registrations refused past the cap
+
+
+class Counter:
+    """Monotonic event count."""
+
+    __slots__ = ("name", "_n")
+    kind = "counter"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._n = 0
+
+    def inc(self, n: int = 1) -> None:
+        with _lock:
+            self._n += int(n)
+
+    @property
+    def value(self) -> int:
+        return self._n
+
+
+class Gauge:
+    """Last-written value (overlap fraction, solves/s, queue depth)."""
+
+    __slots__ = ("name", "_v")
+    kind = "gauge"
+
+    def __init__(self, name: str):
+        self.name = name
+        self._v = 0.0
+
+    def set(self, v: float) -> None:
+        with _lock:
+            self._v = float(v)
+
+    @property
+    def value(self) -> float:
+        return self._v
+
+
+class Histogram:
+    """Fixed log-spaced-bucket latency histogram (seconds).
+
+    ``counts[0]`` holds observations ≤ the lowest edge, ``counts[i]``
+    (1 ≤ i ≤ len(edges)-1) the half-open bucket (edges[i-1], edges[i]],
+    and ``counts[-1]`` everything above the top edge.  Quantiles walk
+    the cumulative counts to rank ``max(1, ceil(q·total))`` and return
+    that bucket's upper edge — exact, deterministic, saturating at the
+    top edge (never infinity).
+    """
+
+    __slots__ = ("name", "counts", "total", "sum_s")
+    kind = "histogram"
+    edges = _EDGES
+
+    def __init__(self, name: str):
+        self.name = name
+        self.counts = [0] * (len(_EDGES) + 1)
+        self.total = 0
+        self.sum_s = 0.0
+
+    def observe(self, seconds: float) -> None:
+        v = float(seconds)
+        if not math.isfinite(v):
+            return                       # a NaN latency is a bug upstream
+        i = bisect_left(_EDGES, v) if v > _EDGES[0] else 0
+        with _lock:
+            self.counts[i] += 1
+            self.total += 1
+            self.sum_s += v
+
+    def quantile(self, q: float) -> float:
+        """The smallest bucket upper edge covering rank ``ceil(q·total)``
+        (0.0 on an empty histogram)."""
+        if self.total == 0:
+            return 0.0
+        rank = max(1, math.ceil(min(max(q, 0.0), 1.0) * self.total))
+        c = 0
+        for i, n in enumerate(self.counts):
+            c += n
+            if c >= rank:
+                return _EDGES[min(i, len(_EDGES) - 1)]
+        return _EDGES[-1]                # pragma: no cover - unreachable
+
+    def to_dict(self) -> dict:
+        """Snapshot: count/sum, the standard quantiles, and the NONZERO
+        buckets as ``[upper_edge, count]`` pairs (the overflow bucket's
+        edge is the string ``"+Inf"`` — JSON has no infinity)."""
+        buckets = []
+        for i, n in enumerate(self.counts):
+            if n:
+                edge = ("+Inf" if i >= len(_EDGES)
+                        else float(f"{_EDGES[i]:.6g}"))
+                buckets.append([edge, n])
+        return {
+            "count": self.total,
+            "sum_s": round(self.sum_s, 6),
+            "p50": float(f"{self.quantile(0.50):.6g}"),
+            "p90": float(f"{self.quantile(0.90):.6g}"),
+            "p99": float(f"{self.quantile(0.99):.6g}"),
+            "buckets": buckets,
+        }
+
+
+_OVERFLOW_NAME = "<overflow>"
+
+
+def _get(name: str, cls):
+    with _lock:
+        m = _metrics.get(name)
+        if m is not None:
+            if not isinstance(m, cls):
+                raise ValueError(
+                    f"metric {name!r} already registered as {m.kind}, "
+                    f"requested as {cls.kind}")
+            return m
+        if len(_metrics) >= _MAX_METRICS:
+            # bounded-registry contract: degrade to a shared overflow
+            # instance per kind, count the refusal, never grow
+            _dropped[0] += 1
+            key = f"{_OVERFLOW_NAME}.{cls.kind}"
+            m = _metrics.get(key)
+            if m is None and len(_metrics) < _MAX_METRICS + 3:
+                m = _metrics[key] = cls(key)
+            return m if m is not None else cls(key)   # pragma: no cover
+        m = _metrics[name] = cls(name)
+        return m
+
+
+def counter(name: str) -> Counter:
+    return _get(name, Counter)
+
+
+def gauge(name: str) -> Gauge:
+    return _get(name, Gauge)
+
+
+def histogram(name: str) -> Histogram:
+    return _get(name, Histogram)
+
+
+def snapshot() -> dict:
+    """One coherent, JSON-safe view of every registered metric:
+    ``{"counters": {...}, "gauges": {...}, "histograms": {...}}`` plus
+    ``dropped_names`` when the registry cap ever refused a name."""
+    out: dict = {"counters": {}, "gauges": {}, "histograms": {}}
+    # the whole read happens UNDER the lock (to_dict/quantile only read),
+    # excluding concurrent observe()/inc(): the snapshot is coherent —
+    # a histogram's bucket sum always equals its count
+    with _lock:
+        for name, m in sorted(_metrics.items()):
+            if isinstance(m, Counter):
+                out["counters"][name] = m.value
+            elif isinstance(m, Gauge):
+                out["gauges"][name] = float(f"{m.value:.6g}")
+            else:
+                out["histograms"][name] = m.to_dict()
+        if _dropped[0]:
+            out["dropped_names"] = _dropped[0]
+    return out
+
+
+def reset() -> None:
+    """Drop every registered metric (tests, phase boundaries)."""
+    with _lock:
+        _metrics.clear()
+        _dropped[0] = 0

@@ -1,0 +1,230 @@
+"""Obs-smoke: prove the observability layer end-to-end, cross-process.
+
+``python -m raft_tpu.obs`` runs a small mixed-design
+:func:`~raft_tpu.parallel.sweep.sweep_designs` stream (OC3 spar +
+VolturnUS-S + OC4 semi — two shape buckets) in TWO fresh child
+processes sharing one warm-start cache dir — first with ``RAFT_TPU_OBS``
+off, then with it armed at a scratch sink — and asserts:
+
+* the armed child published a **valid JSONL event log** (every line
+  parses; meta + span + metrics records present, zero corrupt lines);
+* the **Chrome trace loads** and is schema-valid (``ph``/``ts``/``dur``/
+  ``pid``/``tid`` on every event, per-thread time-containment nesting
+  consistent) — i.e. Perfetto-loadable;
+* the metrics snapshot carries a **per-bucket dispatch latency
+  histogram** for every bucket signature with deterministic **p50/p99**
+  present, plus the Prometheus exposition file;
+* **overhead guard**: the armed child's timed solve leg (warm
+  executable, best of 3) stays within a small factor of the unarmed
+  child's — instrumentation must never cost the hot path real
+  throughput.
+
+Exit code 0/1; prints one JSON line.  ``make obs-smoke`` wraps it
+(< 60 s CPU); runs in the CI fast job.
+
+``python -m raft_tpu.obs child`` is the per-process payload (internal).
+"""
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+DESIGNS = ("OC3spar", "VolturnUS-S", "OC4semi")
+
+#: the armed child's solves/s may lag the unarmed child's by at most
+#: this factor.  Generous on purpose: the timed leg is only ~10 ms on
+#: CPU, so the CONSTANT per-call publish cost (three sink files per
+#: armed sweep_designs call, ~2 ms) dominates the ratio — on a real
+#: workload (seconds per sweep) it amortizes to noise, and the marginal
+#: span/metric cost is a few µs per bucket.  The guard exists to catch
+#: an accidental O(lanes) instrumentation cost, not to pin the publish
+#: constant; CI boxes also share cores with neighbors.
+OVERHEAD_FACTOR = 2.0
+
+
+def _child(argv) -> None:
+    p = argparse.ArgumentParser(prog="raft_tpu.obs child")
+    p.add_argument("--nw", type=int, default=32)
+    args = p.parse_args(argv)
+
+    # the smoke must never dial a hardware backend: pin CPU before jax init
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from raft_tpu import cache, obs
+    from raft_tpu.model import stage_designs
+    from raft_tpu.parallel.sweep import sweep_designs
+
+    cache.enable()                      # RAFT_TPU_CACHE_DIR from the parent
+
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    fnames = [os.path.join(pkg, "designs", n + ".yaml") for n in DESIGNS]
+    staged = stage_designs(fnames, nw=args.nw, Hs=8.0, Tp=12.0,
+                           w_min=0.05, w_max=2.95)
+
+    # warm-up pass absorbs compile (AOT registry: a later child gets
+    # disk hits); the timed leg below measures pure execution
+    sweep_designs(staged=staged, n_iter=8, return_xi=False)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        out = sweep_designs(staged=staged, n_iter=8, return_xi=False)
+        best = min(best, time.perf_counter() - t0)
+
+    nw_phys = next(iter(staged.values())).nw
+    solves = len(fnames) * nw_phys
+    published = obs.maybe_publish("smoke")
+    print(json.dumps({
+        "armed": obs.enabled(),
+        "n_designs": len(fnames),
+        "n_buckets": out["buckets"]["n_buckets"],
+        "signatures": out["buckets"]["signatures"],
+        "solves_per_s": round(solves / best, 1),
+        "timed_leg_s": round(best, 4),
+        "published": published,
+    }))
+
+
+def _run_child(cache_dir: str, nw: int, obs_dir: str | None) -> dict:
+    env = dict(os.environ)
+    env["RAFT_TPU_CACHE_DIR"] = cache_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    # deterministic whatever environment launches it (cache-smoke
+    # precedent): a caller's virtual-device mesh changes topology, AOT
+    # keys, and XLA-CPU compile times
+    env.pop("XLA_FLAGS", None)
+    env.pop("RAFT_TPU_BUCKETS", None)
+    if obs_dir is None:
+        env.pop("RAFT_TPU_OBS", None)
+    else:
+        env["RAFT_TPU_OBS"] = obs_dir
+    r = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs", "child", "--nw", str(nw)],
+        capture_output=True, text=True, timeout=600, env=env,
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+    )
+    if r.returncode != 0:
+        raise SystemExit(
+            f"obs-smoke child failed (rc={r.returncode}):\n"
+            + (r.stderr or r.stdout)[-2000:]
+        )
+    return json.loads(r.stdout.strip().splitlines()[-1])
+
+
+def _validate_chrome_trace(path: str) -> dict:
+    """Load a Chrome trace file and check trace-event schema + nesting.
+
+    Every event must be a complete event (``ph == "X"``) carrying
+    integer ``ts``/``dur``/``pid``/``tid`` and a name; within one
+    ``tid`` track, events must nest by time containment (a child's
+    ``[ts, ts+dur]`` inside its parent's) — the property Perfetto's
+    slice renderer relies on.
+    """
+    with open(path, "r", encoding="utf-8") as f:
+        doc = json.load(f)
+    events = doc.get("traceEvents")
+    assert isinstance(events, list) and events, "traceEvents missing/empty"
+    for ev in events:
+        for field in ("name", "ph", "ts", "dur", "pid", "tid"):
+            assert field in ev, f"event missing {field!r}: {ev}"
+        assert ev["ph"] == "X", f"unexpected phase {ev['ph']!r}"
+        for field in ("ts", "dur", "pid", "tid"):
+            assert isinstance(ev[field], int), f"non-integer {field}"
+    bad_nesting = 0
+    by_tid: dict = {}
+    for ev in events:
+        by_tid.setdefault(ev["tid"], []).append(ev)
+    for evs in by_tid.values():
+        evs.sort(key=lambda e: (e["ts"], -e["dur"]))
+        stack: list = []          # open-interval end times
+        for ev in evs:
+            while stack and stack[-1] <= ev["ts"]:
+                stack.pop()
+            if stack and ev["ts"] + ev["dur"] > stack[-1]:
+                bad_nesting += 1
+            stack.append(ev["ts"] + ev["dur"])
+    assert bad_nesting == 0, f"{bad_nesting} events violate nesting"
+    return {"events": len(events), "tracks": len(by_tid)}
+
+
+def smoke(argv) -> int:
+    p = argparse.ArgumentParser(prog="raft_tpu.obs smoke")
+    p.add_argument("--nw", type=int, default=32, help="frequency bins")
+    p.add_argument("--dir", default=None,
+                   help="work dir (default: fresh temp dir, removed after)")
+    args = p.parse_args(argv)
+
+    from raft_tpu.obs.export import read_jsonl
+
+    work = args.dir or tempfile.mkdtemp(prefix="raft_tpu_obs_smoke_")
+    cache_dir = os.path.join(work, "cache")
+    obs_dir = os.path.join(work, "obs")
+    try:
+        # child 1: obs OFF (pays the cold compile into the shared cache);
+        # child 2: obs ON (warm AOT hits — the timed legs compare fairly:
+        # both time a warm in-process executable, best of 3)
+        off = _run_child(cache_dir, args.nw, None)
+        on = _run_child(cache_dir, args.nw, obs_dir)
+
+        assert on["published"], "armed child published nothing"
+        jsonl = on["published"]["jsonl"]
+        events, corrupt = read_jsonl(jsonl)
+        kinds = {e.get("type") for e in events}
+        spans = [e for e in events if e.get("type") == "span"]
+        metrics_evs = [e for e in events if e.get("type") == "metrics"]
+        snap = metrics_evs[-1] if metrics_evs else {}
+        hists = snap.get("histograms", {})
+        per_bucket = {k: v for k, v in hists.items()
+                      if k.startswith("sweep_designs.dispatch_s[")}
+        quantiles_ok = all(
+            isinstance(h.get("p50"), float) and isinstance(h.get("p99"), float)
+            and h.get("count", 0) >= 1 for h in per_bucket.values())
+        trace_info = _validate_chrome_trace(on["published"]["chrome_trace"])
+
+        checks = {
+            "jsonl_valid": corrupt == 0 and {"meta", "span", "metrics"}
+                           <= kinds and len(spans) >= 3,
+            "chrome_trace_valid": trace_info["events"] >= 3,
+            "per_bucket_histograms":
+                len(per_bucket) == on["n_buckets"] and quantiles_ok,
+            "prom_written": os.path.exists(on["published"]["prom"]),
+            "overhead_bounded":
+                on["solves_per_s"] * OVERHEAD_FACTOR >= off["solves_per_s"],
+            "unarmed_published_nothing": off["published"] is None,
+        }
+        ok = all(checks.values())
+        print(json.dumps({
+            "ok": ok,
+            **checks,
+            "n_buckets": on["n_buckets"],
+            "jsonl_events": len(events),
+            "chrome_trace": trace_info,
+            "dispatch_histograms": {
+                k: {q: v[q] for q in ("count", "p50", "p99")}
+                for k, v in sorted(per_bucket.items())},
+            "solves_per_s_obs_off": off["solves_per_s"],
+            "solves_per_s_obs_on": on["solves_per_s"],
+            "work_dir": work,
+        }))
+        return 0 if ok else 1
+    finally:
+        if args.dir is None:
+            shutil.rmtree(work, ignore_errors=True)
+
+
+def main() -> int:
+    argv = sys.argv[1:]
+    if argv and argv[0] == "child":
+        _child(argv[1:])
+        return 0
+    return smoke(argv)

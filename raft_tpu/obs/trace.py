@@ -1,0 +1,186 @@
+"""Thread-safe nested span tracing — the measurement half of the
+observability layer (:mod:`raft_tpu.obs`).
+
+A *span* is one timed region of host-side work with a nested name
+("north_star/run/pipeline/fetch"): spans opened while another span is
+open on the SAME thread nest under it, exactly like the historical
+``utils.profiling.phase`` names — but the nesting stack lives in
+``threading.local`` storage, so two threads (a request-serving daemon,
+the ROADMAP item this layer unblocks) can trace concurrently without
+corrupting each other's paths.  Timestamps are monotonic
+(``time.perf_counter_ns`` against a process epoch), never wall-clock.
+
+Memory is BOUNDED (the ``cache.aot.compile_events`` ring precedent): the
+ordered span log is a ring of the most recent :data:`_SPANS_MAX`
+completed spans, while exact per-name ``(count, total seconds)``
+aggregates live in a side table capped at :data:`_AGG_MAX` distinct
+names (excess names aggregate under ``"<other>"``) — roll-up totals stay
+exact long after the ring has wrapped, and a long-lived process can
+never grow either without limit.
+
+Exporters: :func:`chrome_trace` renders the ring as Chrome trace-event
+JSON (complete ``"ph": "X"`` events; load the file in Perfetto or
+``chrome://tracing`` — children nest by time containment per thread
+track), and :func:`rollup` is the machine-readable per-name summary the
+bench JSON embeds.  All host-side: a span can never change a traced
+program, an AOT key, or a compiled artifact.
+"""
+from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import threading
+import time
+from collections import deque
+
+#: completed-span ring bound (compile_events precedent: bounded, recent)
+_SPANS_MAX = 65536
+#: distinct full-path names the exact roll-up tracks before aggregating
+#: the rest under _OVERFLOW
+_AGG_MAX = 4096
+_OVERFLOW = "<other>"
+
+#: process trace epoch — every span timestamp is µs after this instant
+_EPOCH_NS = time.perf_counter_ns()
+
+_lock = threading.Lock()
+_spans: deque = deque(maxlen=_SPANS_MAX)
+_agg: dict = {}                  # full name -> [count, total_seconds]
+_tls = threading.local()
+
+
+@dataclasses.dataclass(frozen=True)
+class Span:
+    """One completed span: full nested ``name``, start/duration in µs
+    relative to the process trace epoch, and the recording thread."""
+
+    name: str
+    t0_us: int
+    dur_us: int
+    tid: int
+    depth: int
+    attrs: tuple = ()            # ((key, value), ...) — small, hashable
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def current_path() -> str:
+    """The open span path on THIS thread ("" outside any span)."""
+    return "/".join(_stack())
+
+
+def record(full: str, t0_ns: int, t1_ns: int, depth: int = 0,
+           attrs: dict | None = None) -> None:
+    """Record one completed span from explicit monotonic-ns endpoints
+    (the :func:`span` context manager's backend; callers that already
+    timed a region feed it here rather than timing twice)."""
+    # µs endpoints are BOTH floored against the epoch and the duration is
+    # their difference — never an independently-floored (t1-t0).  Floor is
+    # monotonic, so a child interval inside its parent's ns interval stays
+    # inside in integer µs too: the time-containment invariant Perfetto's
+    # slice nesting (and the smoke's validator) relies on cannot be broken
+    # by sub-µs rounding.
+    t0_us = max(0, (t0_ns - _EPOCH_NS) // 1000)
+    end_us = max(t0_us, (t1_ns - _EPOCH_NS) // 1000)
+    s = Span(
+        name=full,
+        t0_us=t0_us,
+        dur_us=end_us - t0_us,
+        tid=threading.get_ident() & 0x7FFFFFFF,
+        depth=depth,
+        attrs=tuple(sorted(attrs.items())) if attrs else (),
+    )
+    dt_s = max(0, t1_ns - t0_ns) / 1e9
+    with _lock:
+        _spans.append(s)
+        key = full if (full in _agg or len(_agg) < _AGG_MAX) else _OVERFLOW
+        c = _agg.get(key)
+        if c is None:
+            c = _agg[key] = [0, 0.0]
+        c[0] += 1
+        c[1] += dt_s
+
+
+@contextlib.contextmanager
+def span(name: str, jax_trace: bool = False, attrs: dict | None = None):
+    """Time a named region (nested names join with '/', per thread).
+
+    ``jax_trace=True`` additionally annotates the region in the JAX/XLA
+    profiler timeline (``jax.profiler.TraceAnnotation``; requires an
+    active ``start_trace`` to show up — see ``utils.profiling.xla_trace``).
+    ``attrs`` is a small dict of static labels carried into the Chrome
+    trace event's ``args`` (chunk index, bucket signature, ...).
+
+    The span records on EVERY exit path (exceptions included), and its
+    cost is a few µs of host time: safe on hot host paths, meaningless
+    inside traced code (it would measure tracing, not execution — keep
+    spans outside ``jit``).
+    """
+    st = _stack()
+    full = "/".join([*st, name])
+    st.append(name)
+    ctx = contextlib.nullcontext()
+    if jax_trace:
+        import jax.profiler
+
+        ctx = jax.profiler.TraceAnnotation(full)
+    t0 = time.perf_counter_ns()
+    try:
+        with ctx:
+            yield
+    finally:
+        t1 = time.perf_counter_ns()
+        st.pop()
+        record(full, t0, t1, depth=len(st), attrs=attrs)
+
+
+def spans() -> list:
+    """The bounded ring of completed spans, oldest first."""
+    with _lock:
+        return list(_spans)
+
+
+def rollup() -> dict:
+    """Exact per-name ``{"count", "total_s"}`` aggregates since process
+    start (or the last :func:`reset`) — unlike the ring, never lossy
+    (the ``compile_count`` analog).  Names past the :data:`_AGG_MAX` cap
+    fold into ``"<other>"``."""
+    with _lock:
+        return {k: {"count": v[0], "total_s": round(v[1], 6)}
+                for k, v in sorted(_agg.items())}
+
+
+def chrome_trace() -> dict:
+    """The span ring as a Chrome trace-event JSON object (Perfetto /
+    ``chrome://tracing`` loadable).  Complete events (``"ph": "X"``) with
+    µs timestamps; one track per recording thread; the full nested path
+    rides in ``args.path`` while the event name is the leaf."""
+    pid = os.getpid()
+    events = []
+    for s in spans():
+        events.append({
+            "name": s.name.rsplit("/", 1)[-1],
+            "cat": "raft_tpu",
+            "ph": "X",
+            "ts": s.t0_us,
+            "dur": s.dur_us,
+            "pid": pid,
+            "tid": s.tid,
+            "args": {"path": s.name, **dict(s.attrs)},
+        })
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def reset() -> None:
+    """Clear the span ring and the roll-up aggregates (tests, phase
+    boundaries of long-lived processes).  Open spans on any thread keep
+    their stacks — only completed-span history is dropped."""
+    with _lock:
+        _spans.clear()
+        _agg.clear()

@@ -170,6 +170,7 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
     """
     import jax
 
+    from raft_tpu import obs as _obs
     from raft_tpu.resilience import faults as _faults
 
     if depth is None:
@@ -187,21 +188,23 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
     in_flight: deque = deque()   # (index, dispatched out, donated leaves)
     t_start = time.perf_counter()
 
-    def timed_host(kind, thunk):
+    def timed_host(kind, thunk, chunk_idx):
         t0 = time.perf_counter()
-        out = thunk()
+        with _obs.trace.span(f"pipeline/{kind}", attrs={"chunk": chunk_idx}):
+            out = thunk()
         dt = time.perf_counter() - t0
         if kind == "stage":
             stats.stage_s += dt
         else:
             stats.fetch_s += dt
+        _obs.metrics.histogram(f"pipeline.{kind}_s").observe(dt)
         if in_flight:                  # device had work to hide this under
             stats.overlapped_host_s += dt
         return out
 
     def drain_one():
         k_done, pending, donated = in_flight.popleft()
-        res = timed_host("fetch", lambda: fetch(pending))
+        res = timed_host("fetch", lambda: fetch(pending), k_done)
         if faulty and _faults.chunk_fault("nan_chunk", k_done):
             res = _faults.nan_results(res)
             stats.faults_injected += 1
@@ -229,14 +232,18 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
                 results.append(cached)
                 stats.chunks_resumed += 1
                 continue
-        staged = timed_host("stage", lambda: stage(item))
+        staged = timed_host("stage", lambda: stage(item), k)
         donated = []
         if donate_argnums:
             donated = [leaf for i in donate_argnums
                        for leaf in jax.tree_util.tree_leaves(staged[i])]
             stats.donated_bytes += sum(
                 getattr(leaf, "nbytes", 0) for leaf in donated)
-        out = fn(*staged) if isinstance(staged, tuple) else fn(staged)
+        t_disp = time.perf_counter()
+        with _obs.trace.span("pipeline/dispatch", attrs={"chunk": k}):
+            out = fn(*staged) if isinstance(staged, tuple) else fn(staged)
+        _obs.metrics.histogram("pipeline.dispatch_s").observe(
+            time.perf_counter() - t_disp)
         in_flight.append((k, out, donated))
         stats.chunks_computed += 1
         stats.max_in_flight = max(stats.max_in_flight, len(in_flight))
@@ -253,6 +260,14 @@ def run_pipelined(fn, items, *, depth: int | None = None, stage=None,
     if ckpt is not None:
         stats.ckpt_corrupt = ckpt.corrupt
     stats.wall_s = time.perf_counter() - t_start
+    # registry mirror of the per-pass stats (the checkpoint store counts
+    # its own saved/resumed/corrupt events — not repeated here)
+    _obs.metrics.gauge("pipeline.overlap_fraction").set(stats.overlap_fraction)
+    _obs.metrics.counter("pipeline.chunks_computed").inc(stats.chunks_computed)
+    _obs.metrics.counter("pipeline.chunks_resumed").inc(stats.chunks_resumed)
+    if stats.faults_injected:
+        _obs.metrics.counter("pipeline.faults_injected").inc(
+            stats.faults_injected)
     return results, stats
 
 

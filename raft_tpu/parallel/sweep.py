@@ -13,6 +13,8 @@ embarrassingly parallel — the mesh axis is pure data parallelism over ICI.
 """
 from __future__ import annotations
 
+import time
+
 import numpy as np
 import jax
 import jax.numpy as jnp
@@ -1137,6 +1139,29 @@ def sweep(
         std_from=("Xi_abs2", wave.w) if return_xi else None)
 
 
+def _sig_label(sig) -> str:
+    """Stable short label of a bucket signature for metric/span names
+    ("16x64x128" = segments x nodes x nw)."""
+    return f"{sig.segments}x{sig.nodes}x{sig.nw}"
+
+
+def _record_bucket_metrics(_obs, batch, B, dispatch_s) -> None:
+    """Per-bucket registry feed of one :func:`_sweep_designs_bucket`
+    dispatch: the latency histogram (one per bucket signature — the
+    ladder is a handful of classes, so the name cardinality is bounded
+    by construction), the mixed-stream throughput gauge, and the lane
+    counter the obs-smoke overhead guard reads."""
+    label = _sig_label(batch.sig)
+    _obs.metrics.histogram(f"sweep_designs.dispatch_s[{label}]").observe(
+        dispatch_s)
+    if dispatch_s > 0:
+        # physical solves (lanes x physical frequency bins) per second,
+        # same accounting as the bench's north-star metric
+        _obs.metrics.gauge("sweep_designs.solves_per_s").set(
+            B * batch.nw / dispatch_s)
+    _obs.metrics.counter("sweep_designs.lanes").inc(B)
+
+
 def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
                           chunk, pipeline_depth):
     """Solve ONE shape bucket's stacked design batch as one padded device
@@ -1146,6 +1171,7 @@ def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
     design-agnostic: any mix of designs in this bucket class (and batch
     size) reuses it, in-process and through the AOT registry."""
     from raft_tpu import cache as _cache
+    from raft_tpu import obs as _obs
     from raft_tpu.build import buckets as _buckets
 
     B = len(batch.fnames)
@@ -1215,17 +1241,32 @@ def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
                 extra=(*extra, "chunk", chunk,
                        "data_sha", _ckpt.content_hash(data_leaves)),
                 n_chunks=B // chunk)
-        results, pipe_stats = _pipe.run_pipelined(
-            fn, range(B // chunk), depth=pipeline_depth,
-            stage=lambda k: staged0 if k == 0 else stage(k),
-            ckpt=store)
+        with _obs.trace.span("sweep_designs/bucket",
+                             attrs={"sig": _sig_label(batch.sig),
+                                    "lanes": B, "chunk": chunk}):
+            t0 = time.perf_counter()
+            results, pipe_stats = _pipe.run_pipelined(
+                fn, range(B // chunk), depth=pipeline_depth,
+                stage=lambda k: staged0 if k == 0 else stage(k),
+                ckpt=store)
+            dispatch_s = time.perf_counter() - t0
         outs = tuple(np.concatenate([np.atleast_1d(r[j]) for r in results])
                      for j in range(len(results[0])))
     else:
         fn = _cache.cached_callable(
             "sweep_designs", jax.vmap(one, in_axes=in_axes), args,
             extra=extra)
-        outs = fn(*args)
+        # the span times dispatch THROUGH materialization (the compiled
+        # call returns futures; the results are fetched right below
+        # anyway, so the barrier moves no work — it only makes the
+        # latency histogram honest)
+        with _obs.trace.span("sweep_designs/bucket",
+                             attrs={"sig": _sig_label(batch.sig),
+                                    "lanes": B}):
+            t0 = time.perf_counter()
+            outs = jax.block_until_ready(fn(*args))
+            dispatch_s = time.perf_counter() - t0
+    _record_bucket_metrics(_obs, batch, B, dispatch_s)
     out0, iters = outs[:2]
     if return_xi:
         res = {
@@ -1415,6 +1456,12 @@ def sweep_designs(
             "per_bucket": {str(tuple(b.sig)): res["health"]
                            for b, res in zip(batches, per_bucket)},
         }
+    # with RAFT_TPU_OBS armed, every mixed-design sweep leaves a fresh
+    # JSONL log + Chrome trace + Prometheus snapshot behind (no-op, and
+    # no import cost on the hot path, when the knob is off)
+    from raft_tpu import obs as _obs
+
+    _obs.maybe_publish("sweep_designs")
     return result
 
 

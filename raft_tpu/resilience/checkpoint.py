@@ -146,6 +146,9 @@ class ChunkStore:
         }
         self._write_manifest()
         self.saved += 1
+        from raft_tpu import obs as _obs
+
+        _obs.metrics.counter("ckpt.saved").inc()
 
     def _drop(self, k: int, why: str) -> None:
         import warnings
@@ -154,6 +157,9 @@ class ChunkStore:
             f"checkpoint chunk {k} of {self.key} is unusable ({why}); "
             f"it will be recomputed", stacklevel=3)
         self.corrupt += 1
+        from raft_tpu import obs as _obs
+
+        _obs.metrics.counter("ckpt.corrupt").inc()
         self._manifest["chunks"].pop(str(int(k)), None)
         try:
             os.unlink(self._chunk_path(k))
@@ -178,6 +184,9 @@ class ChunkStore:
             self._drop(k, "content hash mismatch")
             return None
         self.resumed += 1
+        from raft_tpu import obs as _obs
+
+        _obs.metrics.counter("ckpt.resumed").inc()
         return leaves[0] if entry.get("scalar") else tuple(leaves)
 
     def complete(self) -> bool:

@@ -80,6 +80,8 @@ def escalate_lanes(lanes, solve_lane, base_n_iter: int,
     Returns ``(records, salvaged)``: one :class:`LaneHealth` per lane in
     input order, and ``{index: payload}`` for the lanes a rung rescued.
     """
+    from raft_tpu import obs as _obs
+
     records = []
     salvaged = {}
     for idx in np.asarray(lanes).reshape(-1):
@@ -88,6 +90,7 @@ def escalate_lanes(lanes, solve_lane, base_n_iter: int,
                          n_iter=0, quarantined=True)
         for rung in rungs:
             n_iter, relax, tik = rung_knobs(rung, base_n_iter, default_relax)
+            _obs.metrics.counter(f"resilience.rung[{rung.name}]").inc()
             payload, conv, fin, used = solve_lane(idx, n_iter, relax, tik)
             rec.converged = bool(conv)
             rec.finite = bool(fin)
@@ -118,6 +121,7 @@ def quarantine_and_salvage(arrays, conv, finite, solve_lane,
     Returns ``(records, conv, finite)`` — one :class:`LaneHealth` per
     quarantined lane (empty when the batch was healthy).
     """
+    from raft_tpu import obs as _obs
     from raft_tpu.resilience.health import failed_lanes
 
     conv = np.array(conv).astype(bool).reshape(-1)
@@ -126,6 +130,7 @@ def quarantine_and_salvage(arrays, conv, finite, solve_lane,
     bad = failed_lanes(conv, finite, host_values=arrays)
     if not len(bad):
         return [], conv, finite
+    _obs.metrics.counter("resilience.quarantined").inc(len(bad))
     if not escalate:
         it = np.zeros(len(conv), dtype=int) if iters is None else np.asarray(iters)
         # the record's finite verdict folds the host sweep in: a lane
@@ -139,6 +144,9 @@ def quarantine_and_salvage(arrays, conv, finite, solve_lane,
                    for i, hf in zip(bad, host_fin)]
         return records, conv, finite
     records, salvaged = escalate_lanes(bad, solve_lane, base_n_iter)
+    _obs.metrics.counter("resilience.salvaged").inc(len(salvaged))
+    _obs.metrics.counter("resilience.unsalvaged").inc(
+        len(bad) - len(salvaged))
     for idx, payload in salvaged.items():
         for arr, val in zip(arrays, payload):
             arr[idx] = val

@@ -1,55 +1,73 @@
-"""Tracing / profiling hooks.
+"""Back-compat phase timers over the unified span API.
 
-The reference has no instrumentation at all (SURVEY.md §5); this module is
-the greenfield equivalent: lightweight wall-clock phase timers that nest,
-a summary table, and an optional bridge into ``jax.profiler`` traces for
-XLA-level timelines viewable in TensorBoard/Perfetto.
+Historically this module WAS the instrumentation: module-global
+wall-clock phase timers.  It is now a thin shim over
+:mod:`raft_tpu.obs.trace` — ``phase`` opens a real span (so every
+``prof.phase`` call site shows up in the Chrome trace and the span
+roll-up for free), and ``totals``/``summary`` read the span aggregates.
+Kept because ~30 call sites (bench.py, cache/, model.py, array.py, the
+smokes) speak this vocabulary; new code should use ``obs.trace.span``
+directly.
+
+Two long-standing ``phase`` bugs die in the migration:
+
+* the nesting stack was a module-global list — two threads timing
+  concurrently (the ROADMAP solver daemon) would interleave pushes and
+  corrupt each other's nested names; the span API keeps one stack per
+  thread (``threading.local``);
+* the exit sync blocked on **every live device array** in the process,
+  charging unrelated buffers' pending compute to whatever phase happened
+  to close first.  The sync is now SCOPED: only arrays that became live
+  during the block are waited on (a liveness-delta of ``id()``s —
+  blast radius: an array allocated in the block that reuses the id of
+  one freed mid-block is missed, a rare under-sync that can only shift
+  a timing, never a result; pass ``sync="all"`` for the old
+  whole-process barrier when a phase must absorb everything).
 """
 from __future__ import annotations
 
 import contextlib
-import time
-from collections import defaultdict
 
-_totals: dict = defaultdict(float)
-_counts: dict = defaultdict(int)
-_stack: list = []
+from raft_tpu.obs import trace as _trace
+
+
+def _live_ids() -> set:
+    import jax
+
+    return {id(a) for a in jax.live_arrays()}
+
+
+def _sync(before: set | None) -> None:
+    """Block until the arrays produced since ``before`` (or all live
+    arrays, when ``before`` is None) are ready."""
+    import jax
+
+    (jax.effects_barrier if hasattr(jax, "effects_barrier") else _noop)()
+    for d in jax.live_arrays():
+        if before is None or id(d) not in before:
+            d.block_until_ready()
 
 
 @contextlib.contextmanager
-def phase(name: str, jax_trace: bool = False, sync: bool = True):
-    """Time a named phase (nested names join with '/').
+def phase(name: str, jax_trace: bool = False, sync=True):
+    """Time a named phase (nested names join with '/', per thread).
 
     JAX dispatch is asynchronous: without a device sync, a block would be
-    charged only its trace/dispatch time and the compute would bleed into a
-    later phase.  ``sync=True`` (default) blocks on all live device arrays
-    at phase exit so wall-clock numbers are honest; pass ``sync=False``
-    inside hot loops where the barrier would serialize useful overlap.
+    charged only its trace/dispatch time and the compute would bleed into
+    a later phase.  ``sync=True`` (default) blocks at phase exit on the
+    arrays the block PRODUCED (liveness delta — unrelated in-flight work
+    is no longer charged here); ``sync="all"`` restores the historical
+    whole-process barrier; ``sync=False`` skips the barrier entirely
+    (hot loops where it would serialize useful overlap).
 
-    With ``jax_trace=True`` the block is also annotated in the JAX profiler
-    timeline (requires an active ``start_trace``)."""
-    full = "/".join([*_stack, name])
-    _stack.append(name)
-    ctx = contextlib.nullcontext()
-    if jax_trace:
-        import jax.profiler
-
-        ctx = jax.profiler.TraceAnnotation(full)
-    t0 = time.perf_counter()
-    try:
-        with ctx:
-            yield
-            if sync:
-                import jax
-
-                (jax.effects_barrier if hasattr(jax, "effects_barrier") else _noop)()
-                for d in jax.live_arrays():
-                    d.block_until_ready()
-    finally:
-        dt = time.perf_counter() - t0
-        _stack.pop()
-        _totals[full] += dt
-        _counts[full] += 1
+    With ``jax_trace=True`` the block is also annotated in the JAX
+    profiler timeline (requires an active ``start_trace``).
+    """
+    with _trace.span(name, jax_trace=jax_trace):
+        before = _live_ids() if sync is True else None
+        yield
+        if sync:
+            _sync(before)
 
 
 def _noop():
@@ -70,20 +88,21 @@ def xla_trace(log_dir: str):
 
 
 def summary() -> str:
-    """Formatted table of accumulated phase timings."""
+    """Formatted table of accumulated span/phase timings."""
     lines = ["phase                                    calls   total [s]   mean [ms]"]
-    for name in sorted(_totals):
-        n = _counts[name]
-        tot = _totals[name]
+    for name, agg in _trace.rollup().items():
+        n, tot = agg["count"], agg["total_s"]
         lines.append(f"{name:<40} {n:>5} {tot:>11.3f} {tot / n * 1e3:>11.2f}")
     return "\n".join(lines)
 
 
 def totals() -> dict:
-    """Accumulated {phase: seconds} — e.g. for embedding in a bench JSON."""
-    return dict(_totals)
+    """Accumulated {phase: seconds} — e.g. for embedding in a bench JSON.
+    (Exact past the span ring bound: backed by the roll-up aggregates,
+    not the ring.)"""
+    return {k: v["total_s"] for k, v in _trace.rollup().items()}
 
 
 def reset():
-    _totals.clear()
-    _counts.clear()
+    """Clear accumulated span history (the shim's totals with it)."""
+    _trace.reset()

@@ -15,6 +15,10 @@ os.environ["JAX_PLATFORMS"] = "cpu"
 # in per-test with an explicit tmp dir (an explicit enable(dir) argument
 # overrides this env pin).
 os.environ["RAFT_TPU_CACHE_DIR"] = "off"
+# observability export defaults OFF; a developer environment that armed
+# RAFT_TPU_OBS must not make the suite write sink files (tests that
+# exercise the exporters pass explicit tmp directories)
+os.environ.pop("RAFT_TPU_OBS", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

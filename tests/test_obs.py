@@ -1,0 +1,430 @@
+"""Observability layer: span tracing, bounded metrics, exporters.
+
+Covers the PR-11 acceptance surface: Chrome trace schema + nesting,
+exact histogram quantile math on hand-built bucket counts, bounded
+buffers past the ring wrap, corruption-tolerant JSONL reads (mid-write
+kill survival), the profiling back-compat shim (thread safety, scoped
+sync), and the pipeline/registry instrumentation hooks.
+"""
+import json
+import math
+import os
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu import obs
+from raft_tpu.obs import export, metrics, trace
+
+
+@pytest.fixture(autouse=True)
+def _fresh_obs():
+    obs.reset()
+    yield
+    obs.reset()
+
+
+# ------------------------------------------------------------- spans ----
+
+def test_span_nesting_and_rollup():
+    with trace.span("outer"):
+        with trace.span("inner"):
+            pass
+        with trace.span("inner"):
+            pass
+    r = trace.rollup()
+    assert r["outer"]["count"] == 1
+    assert r["outer/inner"]["count"] == 2
+    # parent wall-clock covers its children
+    assert r["outer"]["total_s"] >= r["outer/inner"]["total_s"]
+
+
+def test_span_records_on_exception():
+    with pytest.raises(RuntimeError):
+        with trace.span("boom"):
+            raise RuntimeError("x")
+    assert trace.rollup()["boom"]["count"] == 1
+    # the stack unwound: a later span is NOT nested under "boom"
+    with trace.span("after"):
+        pass
+    assert "after" in trace.rollup()
+
+
+def test_span_thread_safety_separate_stacks():
+    """Two threads nesting concurrently must never see each other's
+    stack (the module-global-list bug the span API replaces)."""
+    n, reps = 4, 200
+    start = threading.Barrier(n)
+
+    def worker(i):
+        start.wait()
+        for _ in range(reps):
+            with trace.span(f"t{i}"):
+                with trace.span("leaf"):
+                    pass
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    r = trace.rollup()
+    for i in range(n):
+        assert r[f"t{i}"]["count"] == reps
+        assert r[f"t{i}/leaf"]["count"] == reps
+    # no cross-thread contamination: every name is one of the expected
+    assert set(r) == {f"t{i}" for i in range(n)} | {
+        f"t{i}/leaf" for i in range(n)}
+
+
+def test_span_ring_bounded_rollup_exact():
+    """The ordered span log is a bounded ring; the roll-up counts stay
+    exact past the wrap (the compile_events / compile_count contract)."""
+    n = trace._SPANS_MAX + 50
+    for _ in range(n):
+        trace.record("wrap", 0, 1000)
+    assert len(trace.spans()) == trace._SPANS_MAX
+    assert trace.rollup()["wrap"]["count"] == n
+
+
+def test_rollup_name_cap_overflows_to_other():
+    for i in range(trace._AGG_MAX + 7):
+        trace.record(f"name{i}", 0, 1000)
+    r = trace.rollup()
+    assert len(r) == trace._AGG_MAX + 1          # cap + "<other>"
+    assert r[trace._OVERFLOW]["count"] == 7
+
+
+def test_chrome_trace_schema():
+    with trace.span("a", attrs={"k": 3}):
+        with trace.span("b"):
+            pass
+    doc = trace.chrome_trace()
+    evs = doc["traceEvents"]
+    assert len(evs) == 2
+    for ev in evs:
+        assert ev["ph"] == "X"
+        for field in ("ts", "dur", "pid", "tid"):
+            assert isinstance(ev[field], int) and ev[field] >= 0
+        assert ev["cat"] == "raft_tpu"
+    names = {ev["name"] for ev in evs}
+    assert names == {"a", "b"}
+    paths = {ev["args"]["path"] for ev in evs}
+    assert paths == {"a", "a/b"}
+    assert [ev for ev in evs if ev["name"] == "a"][0]["args"]["k"] == 3
+    json.dumps(doc)                               # JSON-serializable
+
+
+def test_chrome_trace_nesting_consistent():
+    """Children lie within their parent's [ts, ts+dur] on the same tid —
+    the containment property Perfetto's slice nesting renders."""
+    with trace.span("p"):
+        with trace.span("c1"):
+            pass
+        with trace.span("c2"):
+            pass
+    evs = trace.chrome_trace()["traceEvents"]
+    by = {ev["args"]["path"]: ev for ev in evs}
+    p = by["p"]
+    for path in ("p/c1", "p/c2"):
+        c = by[path]
+        assert c["tid"] == p["tid"]
+        assert p["ts"] <= c["ts"]
+        assert c["ts"] + c["dur"] <= p["ts"] + p["dur"]
+
+
+def test_chrome_trace_containment_survives_subus_rounding():
+    """dur_us derives from FLOORED endpoints, not an independently-floored
+    (t1-t0): a child whose ns interval lies inside its parent's must stay
+    inside in integer µs (the pair below violated containment under the
+    old arithmetic: parent [999, 2000]ns rounded to [0, 1]µs while its
+    child [1000, 2000]ns rounded to [1, 2]µs)."""
+    e = trace._EPOCH_NS
+    trace.record("p", e + 999, e + 2000, depth=0)
+    trace.record("p/c", e + 1000, e + 2000, depth=1)
+    by = {s.name: s for s in trace.spans()}
+    p, c = by["p"], by["p/c"]
+    assert p.t0_us <= c.t0_us
+    assert c.t0_us + c.dur_us <= p.t0_us + p.dur_us
+
+
+# ----------------------------------------------------------- metrics ----
+
+def test_histogram_quantiles_exact_on_hand_built_counts():
+    """Deterministic quantile math: rank-walk to the bucket UPPER edge,
+    verified against hand-placed observations in known buckets."""
+    h = metrics.histogram("q_s")
+    edges = metrics.Histogram.edges
+    # 10 observations: 5 in the bucket ending at edges[10], 4 ending at
+    # edges[20], 1 ending at edges[30] (observe just below each edge)
+    for _ in range(5):
+        h.observe(edges[10] * 0.999)
+    for _ in range(4):
+        h.observe(edges[20] * 0.999)
+    h.observe(edges[30] * 0.999)
+    # total 10: p50 -> rank 5 -> first bucket; p90 -> rank 9 -> second;
+    # p99 -> rank 10 -> third
+    assert h.quantile(0.50) == edges[10]
+    assert h.quantile(0.90) == edges[20]
+    assert h.quantile(0.99) == edges[30]
+    assert h.total == 10
+    assert h.quantile(0.0) == edges[10]           # rank clamps to 1
+
+
+def test_histogram_under_and_overflow_saturate():
+    h = metrics.histogram("sat_s")
+    h.observe(0.0)                                # at/below lowest edge
+    h.observe(1e12)                               # beyond top edge
+    edges = metrics.Histogram.edges
+    assert h.quantile(0.5) == edges[0]
+    assert h.quantile(1.0) == edges[-1]           # saturates, never inf
+    d = h.to_dict()
+    assert d["buckets"][-1][0] == "+Inf"
+    assert all(math.isfinite(d[q]) for q in ("p50", "p90", "p99"))
+    json.dumps(d)
+
+
+def test_histogram_ignores_nonfinite():
+    h = metrics.histogram("nan_s")
+    h.observe(float("nan"))
+    h.observe(float("inf"))
+    assert h.total == 0 and h.quantile(0.5) == 0.0
+
+
+def test_counter_and_gauge():
+    c = metrics.counter("events")
+    c.inc()
+    c.inc(4)
+    metrics.gauge("level").set(0.75)
+    snap = metrics.snapshot()
+    assert snap["counters"]["events"] == 5
+    assert snap["gauges"]["level"] == 0.75
+
+
+def test_metric_kind_collision_raises():
+    metrics.counter("dual")
+    with pytest.raises(ValueError, match="already registered"):
+        metrics.gauge("dual")
+
+
+def test_metric_registry_bounded():
+    """Past the name cap, registrations degrade to a shared overflow
+    instance and are counted — memory stays bounded."""
+    for i in range(metrics._MAX_METRICS):
+        metrics.counter(f"c{i}")
+    extra = metrics.counter("one_too_many")
+    extra2 = metrics.counter("two_too_many")
+    assert extra is extra2                        # shared overflow
+    extra.inc()
+    snap = metrics.snapshot()
+    assert snap["dropped_names"] == 2
+    assert len(snap["counters"]) <= metrics._MAX_METRICS + 3
+
+
+def test_snapshot_json_safe():
+    metrics.counter("a").inc()
+    metrics.gauge("b").set(1e-9)
+    metrics.histogram("c_s").observe(1e9)         # overflow bucket
+    json.dumps(metrics.snapshot())                # strict JSON, no Infinity
+
+
+# --------------------------------------------------------- exporters ----
+
+def test_prometheus_text_cumulative_buckets():
+    metrics.counter("hits").inc(3)
+    h = metrics.histogram("lat_s")
+    for v in (1e-4, 1e-4, 0.2):
+        h.observe(v)
+    text = export.prometheus_text()
+    assert "# TYPE raft_tpu_hits counter" in text
+    assert "raft_tpu_hits 3" in text
+    assert "# TYPE raft_tpu_lat_s histogram" in text
+    assert 'raft_tpu_lat_s_bucket{le="+Inf"} 3' in text
+    assert "raft_tpu_lat_s_count 3" in text
+    # cumulative: every bucket line's value is non-decreasing
+    vals = [int(line.rsplit(" ", 1)[1]) for line in text.splitlines()
+            if line.startswith("raft_tpu_lat_s_bucket")]
+    assert vals == sorted(vals)
+
+
+def test_publish_atomic_and_loadable(tmp_path):
+    with trace.span("phase"):
+        metrics.counter("n").inc()
+    paths = export.publish("t", directory=str(tmp_path))
+    # atomic publish leaves no tmp droppings
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+    events, corrupt = export.read_jsonl(paths["jsonl"])
+    assert corrupt == 0
+    kinds = [e["type"] for e in events]
+    assert kinds[0] == "meta" and "span" in kinds and kinds[-1] == "metrics"
+    with open(paths["chrome_trace"]) as f:
+        assert json.load(f)["traceEvents"]
+    assert os.path.getsize(paths["prom"]) > 0
+
+
+def test_publish_disabled_is_noop(tmp_path, monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_OBS", raising=False)
+    assert not export.enabled()
+    assert export.maybe_publish("x") is None
+    monkeypatch.setenv("RAFT_TPU_OBS", "off")
+    assert not export.enabled()
+    with pytest.raises(RuntimeError, match="not armed"):
+        export.publish("x")
+
+
+def test_env_arming_resolves_directory(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_OBS", str(tmp_path / "sink"))
+    assert export.enabled()
+    with trace.span("s"):
+        pass
+    paths = export.maybe_publish("armed")
+    assert paths and os.path.dirname(paths["jsonl"]) == str(tmp_path / "sink")
+
+
+def test_read_jsonl_tolerates_midwrite_kill(tmp_path):
+    """A log truncated mid-line (non-atomic foreign writer killed) keeps
+    its valid prefix loadable — the ChunkStore corruption rule."""
+    p = tmp_path / "log.jsonl"
+    good = [json.dumps({"type": "span", "name": "a"}),
+            json.dumps({"type": "span", "name": "b"})]
+    # a torn tail: half a JSON object, then binary garbage
+    p.write_text("\n".join(good) + "\n" + '{"type": "spa' + "\n\x00\x01\n")
+    events, corrupt = export.read_jsonl(str(p))
+    assert [e["name"] for e in events] == ["a", "b"]
+    assert corrupt == 2
+
+
+def test_obs_block_shape_and_json():
+    with trace.span("roll"):
+        pass
+    metrics.counter("k").inc()
+    metrics.histogram("h_s").observe(0.01)
+    block = export.obs_block()
+    assert block["spans"]["roll"]["count"] == 1
+    assert block["counters"]["k"] == 1
+    assert {"p50", "p90", "p99", "count"} <= set(block["histograms"]["h_s"])
+    assert isinstance(block["compiles"], dict)
+    json.dumps(block)
+
+
+# ---------------------------------------------- profiling shim (compat) ----
+
+def test_profiling_shim_totals_and_summary():
+    from raft_tpu.utils import profiling as prof
+
+    prof.reset()
+    with prof.phase("alpha", sync=False):
+        with prof.phase("beta", sync=False):
+            pass
+    t = prof.totals()
+    assert set(t) == {"alpha", "alpha/beta"}
+    assert "alpha/beta" in prof.summary()
+    prof.reset()
+    assert prof.totals() == {}
+
+
+def test_profiling_shim_feeds_spans():
+    """Every prof.phase call site now lands in the Chrome trace for
+    free — the migration's point."""
+    from raft_tpu.utils import profiling as prof
+
+    with prof.phase("migrated", sync=False):
+        pass
+    assert any(s.name == "migrated" for s in trace.spans())
+
+
+def test_profiling_phase_sync_is_scoped():
+    """The exit sync waits only on arrays produced INSIDE the block —
+    the all-live-arrays blast radius is gone (daemon-bound fix)."""
+    import jax.numpy as jnp
+
+    from raft_tpu.utils import profiling as prof
+
+    pre = jnp.arange(8.0) * 2          # live before the phase
+    with prof.phase("scoped"):
+        inside = jnp.ones(4) + 1
+    # functional check: results correct, phase recorded, both arrays fine
+    assert float(inside.sum()) == 8.0
+    assert float(pre[1]) == 2.0
+    assert trace.rollup()["scoped"]["count"] == 1
+    # the delta helper really excludes pre-existing arrays
+    before = prof._live_ids()
+    assert id(pre) in before
+
+
+def test_profiling_threaded_phases_do_not_cross():
+    from raft_tpu.utils import profiling as prof
+
+    barrier = threading.Barrier(2)
+
+    def run(tag):
+        barrier.wait()
+        for _ in range(50):
+            with prof.phase(tag, sync=False):
+                pass
+
+    a = threading.Thread(target=run, args=("ta",))
+    b = threading.Thread(target=run, args=("tb",))
+    a.start(); b.start(); a.join(); b.join()
+    t = prof.totals()
+    assert set(t) == {"ta", "tb"}      # never "ta/tb" or "tb/ta"
+
+
+# ------------------------------------------------- instrumentation ----
+
+def test_pipeline_feeds_spans_and_metrics():
+    from raft_tpu.parallel.pipeline import run_pipelined
+
+    results, stats = run_pipelined(
+        lambda x: x * 2, [1, 2, 3],
+        stage=lambda k: np.asarray(float(k)),
+        fetch=lambda o: float(o), depth=2)
+    assert results == [2.0, 4.0, 6.0]
+    snap = metrics.snapshot()
+    assert snap["histograms"]["pipeline.stage_s"]["count"] == 3
+    assert snap["histograms"]["pipeline.fetch_s"]["count"] == 3
+    assert snap["histograms"]["pipeline.dispatch_s"]["count"] == 3
+    assert snap["counters"]["pipeline.chunks_computed"] == 3
+    assert "pipeline.overlap_fraction" in snap["gauges"]
+    paths = {s.name for s in trace.spans()}
+    assert {"pipeline/stage", "pipeline/dispatch", "pipeline/fetch"} <= paths
+
+
+def test_cache_stats_mirror_into_registry():
+    from raft_tpu.cache import stats as cstats
+
+    cstats.record("aot", "mem_hit")
+    cstats.record("aot", "mem_hit")
+    cstats.record("staging", "miss")
+    snap = metrics.snapshot()
+    assert snap["counters"]["cache.aot.mem_hit"] == 2
+    assert snap["counters"]["cache.staging.miss"] == 1
+
+
+@pytest.mark.slow
+def test_sweep_designs_emits_bucket_histograms(tmp_path, monkeypatch):
+    """End-to-end (single design, tiny grid): a sweep_designs run with
+    RAFT_TPU_OBS armed publishes a loadable trace + per-bucket dispatch
+    histogram with quantiles.  The cross-process mixed-stream proof is
+    ``make obs-smoke``."""
+    from raft_tpu.parallel.sweep import sweep_designs
+
+    monkeypatch.setenv("RAFT_TPU_OBS", str(tmp_path))
+    pkg = os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "raft_tpu")
+    out = sweep_designs([os.path.join(pkg, "designs", "OC3spar.yaml")],
+                        nw=12, n_iter=4, return_xi=False)
+    snap = metrics.snapshot()
+    names = [k for k in snap["histograms"]
+             if k.startswith("sweep_designs.dispatch_s[")]
+    assert len(names) == out["buckets"]["n_buckets"] == 1
+    h = snap["histograms"][names[0]]
+    assert h["count"] >= 1 and h["p50"] > 0 and h["p99"] >= h["p50"]
+    assert snap["gauges"]["sweep_designs.solves_per_s"] > 0
+    # the armed sweep published its sinks
+    files = os.listdir(tmp_path)
+    assert any(f.startswith("obs-sweep_designs") for f in files)
+    assert any(f.startswith("trace-sweep_designs") for f in files)
+    assert any(s.name.endswith("sweep_designs/bucket")
+               for s in trace.spans())

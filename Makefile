@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke lint lint-budgets
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke lint lint-budgets
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -27,6 +27,9 @@ hetero-smoke:    ## shape-bucket proof: mixed OC3+VolturnUS+OC4 stream compiles
 
 obs-smoke:       ## observability proof: RAFT_TPU_OBS-armed sweep emits valid
 	python -m raft_tpu.obs           # JSONL + Chrome trace + p50/p99, bounded overhead
+
+race-smoke:      ## deterministic N-thread race proof: single-flight AOT compile,
+	python -m raft_tpu.lint.race     # exact metric/ckpt/fault counters (< 60 s CPU)
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

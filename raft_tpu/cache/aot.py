@@ -41,6 +41,7 @@ import hashlib
 import inspect
 import os
 import tempfile
+import threading
 import time
 from collections import Counter, deque
 
@@ -49,7 +50,14 @@ import numpy as np
 from raft_tpu.cache import config, stats
 from raft_tpu.cache.staging import _update
 
+# in-process executable memo + the single-flight table of in-progress
+# builds.  ONE lock guards both: under concurrent requests (the ROADMAP
+# resident solver service) every key is compiled by exactly one thread —
+# followers wait on the leader's event instead of re-lowering the same
+# program (`make race-smoke` pins one compile per contended key).
 _mem: dict = {}
+_mem_lock = threading.Lock()
+_inflight: dict = {}            # key -> threading.Event of the build
 
 # tags of executables that were ACTUALLY lowered+compiled in this process
 # (every reuse layer missed) — the evidence stream behind compile-count
@@ -61,8 +69,20 @@ _mem: dict = {}
 # since process start (or the last reset) live in _compile_counts —
 # count deltas stay correct even after the ring has wrapped.
 _COMPILE_EVENTS_MAX = 4096
+# ring + counters move together under ONE lock: a reset concurrent with
+# an append can never tear them apart (count without event, or vice
+# versa), so per-window compile counts stay exact in a threaded daemon
+_events_lock = threading.Lock()
 _compile_events: deque = deque(maxlen=_COMPILE_EVENTS_MAX)
 _compile_counts: Counter = Counter()
+
+
+def _record_compile(tag: str) -> None:
+    """Count one real compile (every warm layer missed): the ordered ring
+    and the exact counter update atomically under the events lock."""
+    with _events_lock:
+        _compile_events.append(tag)
+        _compile_counts[tag] += 1
 
 
 def compile_events(tag: str | None = None) -> list:
@@ -70,9 +90,11 @@ def compile_events(tag: str | None = None) -> list:
     order; filtered to one ``tag`` when given.  The log is a bounded ring
     (:data:`_COMPILE_EVENTS_MAX` most recent events); for counting across
     long windows prefer :func:`compile_count`, which never saturates."""
+    with _events_lock:
+        events = list(_compile_events)
     if tag is None:
-        return list(_compile_events)
-    return [t for t in _compile_events if t == tag]
+        return events
+    return [t for t in events if t == tag]
 
 
 def compile_count(tag: str | None = None) -> int:
@@ -80,24 +102,29 @@ def compile_count(tag: str | None = None) -> int:
     :func:`reset_compile_events`): per ``tag``, or total.  Unlike
     ``len(compile_events(tag))`` this stays exact after the bounded
     event ring wraps."""
-    if tag is None:
-        return sum(_compile_counts.values())
-    return _compile_counts.get(tag, 0)
+    with _events_lock:
+        if tag is None:
+            return sum(_compile_counts.values())
+        return _compile_counts.get(tag, 0)
 
 
 def compile_counts() -> dict:
     """Exact {tag: real compiles} since process start (or the last
     :func:`reset_compile_events`) — the per-tag form of
     :func:`compile_count`, e.g. for the ``obs`` bench block."""
-    return dict(_compile_counts)
+    with _events_lock:
+        return dict(_compile_counts)
 
 
 def reset_compile_events() -> None:
     """Zero the compile-event log AND counters — phase boundaries of
     long-lived processes (bench passes, a resident solver service)
-    measure per-window compile counts without unbounded growth."""
-    _compile_events.clear()
-    _compile_counts.clear()
+    measure per-window compile counts without unbounded growth.  Atomic
+    with respect to concurrent :func:`_record_compile` calls: a window
+    can never observe a negative or double-counted delta."""
+    with _events_lock:
+        _compile_events.clear()
+        _compile_counts.clear()
 
 
 def _version_salts() -> tuple:
@@ -284,8 +311,12 @@ def _disk_path(key: str) -> str:
 
 def _try_load(key: str):
     """Deserialize a stored executable; None on any failure (the corrupt
-    artifact is removed so it cannot fail every future run)."""
-    path = _disk_path(key)
+    artifact is removed so it cannot fail every future run; a cache root
+    disabled by a concurrent thread is just a miss)."""
+    try:
+        path = _disk_path(key)
+    except config.CacheDisabledError:
+        return None
     if not os.path.exists(path):
         return None
     from raft_tpu.utils import profiling as prof
@@ -365,27 +396,43 @@ def cached_compile(tag: str, fn, args, *, consts=(), mesh=None,
 
     key = aot_key(tag, args, consts=consts, mesh=mesh,
                   extra=(*tuple(extra), donation_salt(kw)))
-    hit = _mem.get(key)
-    if hit is not None:
-        stats.record("aot", "mem_hit")
-        return hit
-    loaded = _try_load(key)
-    if loaded is not None:
-        _mem[key] = loaded
-        return loaded
-    t0 = time.perf_counter()
-    with prof.phase("cache/aot_compile", sync=False):
-        compiled = jax.jit(fn, **kw).lower(*args).compile()
-    cold_s = time.perf_counter() - t0
-    stats.record("aot", "miss")
-    from raft_tpu import obs as _obs
+    # single-flight get-or-compute: the first thread to claim a key
+    # becomes its leader (registers an in-flight event and builds);
+    # followers wait on the event and re-check the memo, so N concurrent
+    # requests for one program cost exactly one lowering+compile.  A
+    # leader that fails sets the event without publishing, and a waiter
+    # retries as the new leader rather than caching the failure.
+    while True:
+        with _mem_lock:
+            hit = _mem.get(key)
+            if hit is not None:
+                stats.record("aot", "mem_hit")
+                return hit
+            ev = _inflight.get(key)
+            if ev is None:
+                ev = _inflight[key] = threading.Event()
+                break
+        ev.wait()
+    try:
+        compiled = _try_load(key)
+        if compiled is None:
+            t0 = time.perf_counter()
+            with prof.phase("cache/aot_compile", sync=False):
+                compiled = jax.jit(fn, **kw).lower(*args).compile()
+            cold_s = time.perf_counter() - t0
+            stats.record("aot", "miss")
+            from raft_tpu import obs as _obs
 
-    _obs.metrics.histogram("aot.compile_s").observe(cold_s)
-    _compile_events.append(tag)
-    _compile_counts[tag] += 1
-    _try_store(key, compiled, cold_s)
-    _mem[key] = compiled
-    return compiled
+            _obs.metrics.histogram("aot.compile_s").observe(cold_s)
+            _record_compile(tag)
+            _try_store(key, compiled, cold_s)
+        with _mem_lock:
+            _mem[key] = compiled
+        return compiled
+    finally:
+        with _mem_lock:
+            _inflight.pop(key, None)
+        ev.set()
 
 
 def cached_callable(tag: str, fn, args, *, consts=(), mesh=None,
@@ -407,6 +454,8 @@ def cached_callable(tag: str, fn, args, *, consts=(), mesh=None,
 
 
 def clear_memory() -> None:
-    """Drop the in-process memo (tests)."""
-    _mem.clear()
+    """Drop the in-process memo (tests).  In-flight builds keep their
+    single-flight entries — the leader publishes into the fresh memo."""
+    with _mem_lock:
+        _mem.clear()
     reset_compile_events()

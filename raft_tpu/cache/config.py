@@ -30,11 +30,22 @@ Layout under the root::
 from __future__ import annotations
 
 import os
+import threading
 
 _OFF_SPELLINGS = ("off", "0", "none", "disabled", "false", "no")
 
+# one lock guards the enablement state AND the code-salt memo: a daemon
+# thread toggling the cache while another reads/arms can never observe a
+# half-updated state, and the fingerprint is computed exactly once
+_state_lock = threading.Lock()
 _state = {"enabled": False, "dir": None, "wired": None}
 _code_salt: list = []
+
+
+class CacheDisabledError(RuntimeError):
+    """The cache root vanished between an ``is_enabled()`` check and the
+    path derivation (a concurrent ``disable()``) — callers on the warm
+    path treat it as a cache miss."""
 
 
 def code_fingerprint() -> str:
@@ -46,28 +57,30 @@ def code_fingerprint() -> str:
     rule the native panel solver has always applied to its own source,
     hydro/native_bem.py.)  Conservative on purpose: a docstring edit
     recompiles too — correctness over cache lifetime.  Computed once per
-    process (~1 ms for this package size)."""
-    if not _code_salt:
-        import hashlib
+    process (~1 ms for this package size; the lock makes the compute
+    single-flight, so concurrent first readers share one walk)."""
+    with _state_lock:
+        if not _code_salt:
+            import hashlib
 
-        import raft_tpu
+            import raft_tpu
 
-        h = hashlib.sha256()
-        try:
-            pkg = os.path.dirname(os.path.abspath(raft_tpu.__file__))
-            # sorted() consumes the whole walk, so ordering is already
-            # deterministic regardless of dirent order
-            for dirpath, _dirnames, filenames in sorted(os.walk(pkg)):
-                for fn in sorted(filenames):
-                    if fn.endswith(".py"):
-                        p = os.path.join(dirpath, fn)
-                        h.update(os.path.relpath(p, pkg).encode())
-                        with open(p, "rb") as f:
-                            h.update(f.read())
-            _code_salt.append(h.hexdigest()[:16])
-        except OSError:  # pragma: no cover - unreadable install
-            _code_salt.append("nosalt")
-    return _code_salt[0]
+            h = hashlib.sha256()
+            try:
+                pkg = os.path.dirname(os.path.abspath(raft_tpu.__file__))
+                # sorted() consumes the whole walk, so ordering is already
+                # deterministic regardless of dirent order
+                for dirpath, _dirnames, filenames in sorted(os.walk(pkg)):
+                    for fn in sorted(filenames):
+                        if fn.endswith(".py"):
+                            p = os.path.join(dirpath, fn)
+                            h.update(os.path.relpath(p, pkg).encode())
+                            with open(p, "rb") as f:
+                                h.update(f.read())
+                _code_salt.append(h.hexdigest()[:16])
+            except OSError:  # pragma: no cover - unreadable install
+                _code_salt.append("nosalt")
+        return _code_salt[0]
 
 
 def default_dir() -> str:
@@ -111,21 +124,24 @@ def enable(cache_dir: str | None = None,
     if root is None:
         disable()       # also un-wires a previously-enabled compile cache
         return None
-    _state.update(enabled=True, dir=root)
-    if _state["wired"] != root:        # first call, or a new root (tests)
-        import jax
+    with _state_lock:
+        _state.update(enabled=True, dir=root)
+        if _state["wired"] != root:    # first call, or a new root (tests)
+            import jax
 
-        xla_dir = os.path.join(root, "xla")
-        os.makedirs(xla_dir, exist_ok=True)
-        jax.config.update("jax_compilation_cache_dir", xla_dir)
-        try:
-            jax.config.update("jax_persistent_cache_min_entry_size_bytes",
-                              min_entry_size_bytes)
-            jax.config.update("jax_persistent_cache_min_compile_time_secs",
-                              min_compile_time_secs)
-        except AttributeError:  # pragma: no cover - older jax spelling
-            pass
-        _state["wired"] = root
+            xla_dir = os.path.join(root, "xla")
+            os.makedirs(xla_dir, exist_ok=True)
+            jax.config.update("jax_compilation_cache_dir", xla_dir)
+            try:
+                jax.config.update(
+                    "jax_persistent_cache_min_entry_size_bytes",
+                    min_entry_size_bytes)
+                jax.config.update(
+                    "jax_persistent_cache_min_compile_time_secs",
+                    min_compile_time_secs)
+            except AttributeError:  # pragma: no cover - older jax spelling
+                pass
+            _state["wired"] = root
     return root
 
 
@@ -134,24 +150,36 @@ def disable() -> None:
     artifact is read or written, and the persistent compilation cache is
     un-wired (``jax_compilation_cache_dir=None`` restores jax's
     default-off state) so later compiles are plain uncached ones."""
-    if _state["wired"] is not None:
-        import jax
+    with _state_lock:
+        if _state["wired"] is not None:
+            import jax
 
-        jax.config.update("jax_compilation_cache_dir", None)
-        _state["wired"] = None
-    _state.update(enabled=False, dir=None)
+            jax.config.update("jax_compilation_cache_dir", None)
+            _state["wired"] = None
+        _state.update(enabled=False, dir=None)
 
 
 def is_enabled() -> bool:
-    return bool(_state["enabled"])
+    with _state_lock:
+        return bool(_state["enabled"])
 
 
 def cache_dir() -> str | None:
-    return _state["dir"]
+    with _state_lock:
+        return _state["dir"]
 
 
 def subdir(name: str) -> str:
-    """<root>/<name>, created on demand (caller must hold is_enabled())."""
-    d = os.path.join(_state["dir"], name)
+    """<root>/<name>, created on demand.  Caller checked ``is_enabled()``
+    — but in a threaded process the cache can be disabled BETWEEN that
+    check and this call, so a vanished root raises a typed
+    :class:`CacheDisabledError` (which the AOT disk layers degrade to a
+    miss) rather than a ``TypeError`` out of ``os.path.join(None, ...)``."""
+    with _state_lock:
+        root = _state["dir"]
+    if root is None:
+        raise CacheDisabledError(
+            f"cache disabled concurrently; no root for subdir {name!r}")
+    d = os.path.join(root, name)
     os.makedirs(d, exist_ok=True)
     return d

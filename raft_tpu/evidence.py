@@ -86,6 +86,11 @@ def main():
     bj = (lint.get("json") or {}).get("budgets")
     if bj is not None:
         lint["budgets"] = bj
+    # concurrency-contract summary (GL301-GL303 new/triaged counts): the
+    # daemon-readiness gate rides one key deep in the round artifact too
+    gj = (lint.get("json") or {}).get("gl3xx")
+    if gj is not None:
+        lint["gl3xx"] = gj
     evidence["lint"] = lint
 
     print("[evidence] dryrun_multichip(8) ...", flush=True)

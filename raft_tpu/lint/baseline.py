@@ -10,6 +10,13 @@ line-number-free — rule + file + enclosing function + stripped source
 text — so reformatting elsewhere in a file does not churn the baseline.
 ``python -m raft_tpu.lint --write-baseline`` regenerates the file;
 review the diff like any other code change.
+
+Triage REASONS: the ``_reasons`` map carries a one-line justification
+per fingerprint (the GL3xx concurrency contract requires every
+single-threaded-by-contract finding to say WHY it is safe today — e.g.
+"re-read per call by design; daemon snapshots at arm time").  Reasons
+are maintainer state: a ``--write-baseline`` refresh preserves them for
+fingerprints that survive and drops the rest.
 """
 from __future__ import annotations
 
@@ -36,10 +43,23 @@ def load(path: str | None = None) -> Counter:
 def save(violations: list[Violation], path: str | None = None) -> str:
     path = path or DEFAULT_BASELINE
     counts = Counter(v.fingerprint() for v in violations)
+    reasons: dict = {}
+    if os.path.exists(path):        # preserve surviving triage reasons
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                old = json.load(f)
+            reasons = {k: str(v) for k, v in old.get("_reasons", {}).items()
+                       if k in counts}
+        except (OSError, json.JSONDecodeError, ValueError):
+            reasons = {}
     payload = {
         "_comment": "graftlint baseline: fingerprint -> count of triaged "
                     "pre-existing violations; regenerate with "
-                    "`python -m raft_tpu.lint --write-baseline`",
+                    "`python -m raft_tpu.lint --write-baseline`. "
+                    "_reasons carries the per-fingerprint justification "
+                    "(required for GL3xx single-threaded-by-contract "
+                    "triage).",
+        "_reasons": {k: reasons[k] for k in sorted(reasons)},
         "violations": {k: counts[k] for k in sorted(counts)},
     }
     with open(path, "w", encoding="utf-8") as f:

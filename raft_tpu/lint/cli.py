@@ -98,7 +98,7 @@ def main(argv=None) -> int:
 
     if not args.audit_only:
         from raft_tpu.lint import baseline as bl
-        from raft_tpu.lint.rules import lint_paths
+        from raft_tpu.lint.rules import RULES, lint_paths
 
         targets = list(args.paths) if args.paths else list(DEFAULT_TARGETS)
         try:
@@ -125,6 +125,24 @@ def main(argv=None) -> int:
                   f"{len(violations)} total")
             summary["static"] = {"new": len(fresh), "baselined": absorbed,
                                  "total": len(violations)}
+            # concurrency-contract summary (GL3xx): the daemon-readiness
+            # gate, one key deep here and in EVIDENCE.json (evidence.py
+            # lifts this block) — "new" must stay zero, "triaged" counts
+            # the single-threaded-by-contract findings carried in the
+            # baseline with their reasons
+            gl3_rules = sorted(r for r in RULES if r.startswith("GL3"))
+            gl3 = {}
+            for r in gl3_rules:
+                n_new = sum(1 for v in fresh if v.rule == r)
+                n_total = sum(1 for v in violations if v.rule == r)
+                gl3[r] = {"new": n_new, "triaged": n_total - n_new}
+            summary["gl3xx"] = {
+                "rules": gl3,
+                "ok": all(c["new"] == 0 for c in gl3.values()),
+            }
+            print("[graftlint] gl3xx: " + "  ".join(
+                f"{r}={c['new']} new/{c['triaged']} triaged"
+                for r, c in gl3.items()))
             if fresh:
                 rc = 1
 

@@ -1,0 +1,344 @@
+"""Deterministic runtime race harness for the daemon-path shared state.
+
+The GL3xx static rules (:mod:`raft_tpu.lint.rules`) prove the locking
+*discipline*; this harness proves the locks actually *work*: N threads
+hammer every concurrency-contract surface the ROADMAP resident solver
+service will share, with ``sys.setswitchinterval`` cranked tiny so the
+GIL hands off every few bytecodes (the preemption schedule is what makes
+the pre-fix races reproduce deterministically in seconds instead of
+once a week in production), and every assertion is EXACT — counters, not
+tolerances:
+
+* **AOT single-flight** — N threads request the same ``cached_compile``
+  key concurrently (and pairs of threads contend on distinct keys):
+  exactly ONE compile per key (``compile_count``), every caller handed
+  the same executable object.  Pre-fix, the ``_mem`` get-or-compute
+  double-compiled under contention.
+* **compile-event counters** — writer threads record compile events
+  while a resetter clears the window: counts never tear (no negative or
+  double-counted window), and an uncontended phase counts exactly.
+  Pre-fix, ring and counter were cleared non-atomically.
+* **metrics / span publish** — N×M counter increments, histogram
+  observations and nested spans, with a concurrent snapshot reader:
+  final values exact, histogram bucket sums == totals, and the Chrome
+  trace / snapshot JSON round-trips (zero-corrupt exports).
+* **ChunkStore save/resume** — writer threads checkpoint disjoint chunk
+  sets into ONE store: the manifest ends complete (no entry dropped by
+  the read-modify-write race the per-store lock closes), every chunk
+  resumes content-hash-clean in a fresh store, zero corrupt.
+* **fault counters** — ``hang_subprocess:K`` consumed from N threads
+  fires exactly K times (the counted-fault check-then-act).
+
+``make race-smoke`` wraps ``python -m raft_tpu.lint.race`` (< 60 s CPU;
+CI fast job, next to the cache/hetero/obs smokes).  Prints one JSON
+line; exit 0/1.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import threading
+import time
+
+#: GIL handoff interval during the hammer phases (default is 5 ms; this
+#: forces a potential preemption between nearly every pair of bytecodes,
+#: the schedule under which the pre-fix races reproduce deterministically)
+SWITCH_INTERVAL = 1e-6
+
+THREADS = 8
+
+
+def _run_threads(n: int, target) -> list:
+    """Start ``n`` threads on ``target(i)`` behind one barrier (so the
+    hammer really is concurrent, not serialized by startup skew); join
+    them and return the raised-exception strings."""
+    barrier = threading.Barrier(n)
+    errors: list = []
+
+    def wrap(i):
+        try:
+            barrier.wait(timeout=30)
+            target(i)
+        except Exception as e:      # noqa: BLE001 - reported, not masked
+            errors.append(f"thread {i}: {type(e).__name__}: {e}")
+
+    threads = [threading.Thread(target=wrap, args=(i,)) for i in range(n)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=120)
+    errors.extend(f"thread {t.name} did not join" for t in threads
+                  if t.is_alive())
+    return errors
+
+
+def _check(out: dict, name: str, cond: bool, detail: str) -> None:
+    out.setdefault("checks", {})[name] = bool(cond)
+    if not cond:
+        out.setdefault("failures", []).append(f"{name}: {detail}")
+
+
+def scenario_aot_single_flight(cache_dir: str) -> dict:
+    """Same-key and distinct-key contention on ``cached_compile``."""
+    import jax.numpy as jnp
+
+    from raft_tpu.cache import aot, config
+
+    out: dict = {}
+    config.enable(cache_dir)
+    aot.clear_memory()
+    args = (jnp.arange(8, dtype=jnp.float32),)
+
+    def fn(x):
+        return x * 2.0 + 1.0
+
+    # same key from every thread
+    results: list = [None] * THREADS
+    errors = _run_threads(
+        THREADS,
+        lambda i: results.__setitem__(
+            i, aot.cached_compile("race_same", fn, args)))
+    _check(out, "same_key_no_errors", not errors, "; ".join(errors))
+    _check(out, "same_key_one_compile",
+           aot.compile_count("race_same") == 1,
+           f"compile_count={aot.compile_count('race_same')} (want 1)")
+    _check(out, "same_key_one_executable",
+           len({id(r) for r in results}) == 1,
+           "threads received different executable objects")
+
+    # distinct keys, each contended by a pair of threads
+    n_keys = THREADS // 2
+
+    def worker(i):
+        k = i % n_keys
+        aot.cached_compile(f"race_k{k}", fn, args, extra=("k", k))
+
+    errors = _run_threads(THREADS, worker)
+    _check(out, "distinct_keys_no_errors", not errors, "; ".join(errors))
+    per_key = {k: aot.compile_count(f"race_k{k}") for k in range(n_keys)}
+    _check(out, "distinct_keys_one_compile_each",
+           all(v == 1 for v in per_key.values()),
+           f"per-key compile counts {per_key} (want all 1)")
+    out["compile_counts"] = aot.compile_counts()
+    aot.clear_memory()
+    config.disable()
+    return out
+
+
+def scenario_compile_event_counters() -> dict:
+    """Ring + counter consistency under concurrent record/reset."""
+    from raft_tpu.cache import aot
+
+    out: dict = {}
+    aot.reset_compile_events()
+    writers, per_writer = 4, 3000
+    stop = threading.Event()
+    torn: list = []
+
+    def resetter():
+        while not stop.is_set():
+            aot.reset_compile_events()
+            # tear invariant (this thread is the ONLY resetter, so no
+            # clear can land between its two reads): every event visible
+            # in the ring carried its counter increment atomically under
+            # the events lock, and the counter is monotone between
+            # resets — so a count read AFTER the ring read can never be
+            # smaller.  Pre-fix, the non-atomic reset orphaned the
+            # events appended between ring.clear() and counts.clear()
+            # (ring entries whose increments were wiped), making
+            # count < len(ring) observable.
+            n_ring = len(aot.compile_events("race_evt"))
+            c = aot.compile_count("race_evt")
+            if c < n_ring:
+                torn.append(f"count {c} < ring {n_ring}")
+
+    rt = threading.Thread(target=resetter)
+    rt.start()
+    errors = _run_threads(
+        writers,
+        lambda i: [aot._record_compile("race_evt")
+                   for _ in range(per_writer)])
+    stop.set()
+    rt.join(timeout=30)
+    _check(out, "reset_phase_no_errors", not errors and not torn,
+           "; ".join(errors + torn))
+    aot.reset_compile_events()
+    _check(out, "reset_zeroes", aot.compile_count() == 0
+           and aot.compile_events() == [], "reset left residue")
+    # uncontended-by-reset phase: the count must be EXACT
+    errors = _run_threads(
+        writers,
+        lambda i: [aot._record_compile("race_evt")
+                   for _ in range(per_writer)])
+    total = aot.compile_count("race_evt")
+    _check(out, "exact_count", not errors and total == writers * per_writer,
+           f"count {total} != {writers * per_writer}; {errors}")
+    out["recorded"] = total
+    aot.reset_compile_events()
+    return out
+
+
+def scenario_metrics_and_spans() -> dict:
+    """Exact counters/histograms/span roll-ups + zero-corrupt exports."""
+    from raft_tpu.obs import metrics, trace
+
+    out: dict = {}
+    metrics.reset()
+    trace.reset()
+    per_thread = 2000
+    stop = threading.Event()
+    corrupt: list = []
+
+    def sampler():
+        while not stop.is_set():
+            try:
+                snap = metrics.snapshot()
+                json.dumps(snap)
+                for h in snap.get("histograms", {}).values():
+                    if sum(n for _, n in h["buckets"]) != h["count"]:
+                        corrupt.append("histogram bucket sum != count")
+                json.dumps(trace.chrome_trace())
+            except Exception as e:  # noqa: BLE001
+                corrupt.append(f"{type(e).__name__}: {e}")
+
+    st = threading.Thread(target=sampler)
+    st.start()
+
+    def worker(i):
+        c = metrics.counter("race.events")
+        h = metrics.histogram("race.latency_s")
+        for j in range(per_thread):
+            c.inc()
+            h.observe(1e-4 * ((i + j) % 7 + 1))
+            with trace.span("race/outer"):
+                with trace.span("inner"):
+                    pass
+
+    errors = _run_threads(THREADS, worker)
+    stop.set()
+    st.join(timeout=30)
+    want = THREADS * per_thread
+    _check(out, "no_errors", not errors and not corrupt,
+           "; ".join(errors + corrupt))
+    _check(out, "counter_exact",
+           metrics.counter("race.events").value == want,
+           f"counter {metrics.counter('race.events').value} != {want}")
+    h = metrics.histogram("race.latency_s")
+    _check(out, "histogram_exact",
+           h.total == want and sum(h.counts) == want,
+           f"total {h.total} / bucket sum {sum(h.counts)} != {want}")
+    roll = trace.rollup()
+    _check(out, "span_rollup_exact",
+           roll.get("race/outer", {}).get("count") == want
+           and roll.get("race/outer/inner", {}).get("count") == want,
+           f"rollup counts {roll.get('race/outer')} / "
+           f"{roll.get('race/outer/inner')} != {want}")
+    out["observed"] = want
+    metrics.reset()
+    trace.reset()
+    return out
+
+
+def scenario_chunkstore(tmp: str) -> dict:
+    """Concurrent writers into one store: complete manifest, clean resume."""
+    import numpy as np
+
+    from raft_tpu.resilience.checkpoint import ChunkStore
+
+    out: dict = {}
+    n_chunks, writers = 48, 4
+    store = ChunkStore("race_store", n_chunks, tmp)
+
+    def writer(t):
+        for k in range(t, n_chunks, writers):
+            store.save(k, (np.full(16, k, dtype=np.float32),
+                           np.arange(k + 1)))
+
+    errors = _run_threads(writers, writer)
+    _check(out, "no_errors", not errors, "; ".join(errors))
+    _check(out, "all_saved", store.saved == n_chunks,
+           f"saved {store.saved} != {n_chunks}")
+    _check(out, "manifest_complete", store.complete(),
+           "manifest dropped entries under the concurrent RMW")
+    # a FRESH store (new process analog) must resume every chunk clean
+    resume = ChunkStore("race_store", n_chunks, tmp)
+    loaded = [resume.load(k) for k in range(n_chunks)]
+    _check(out, "resume_all", all(r is not None for r in loaded),
+           f"{sum(r is None for r in loaded)} chunks missing on resume")
+    _check(out, "zero_corrupt", resume.corrupt == 0,
+           f"{resume.corrupt} corrupt chunks")
+    ok_vals = all(
+        r is not None and float(r[0][0]) == float(k)
+        for k, r in enumerate(loaded))
+    _check(out, "values_roundtrip", ok_vals, "resumed values diverged")
+    out["stats"] = resume.to_dict()
+    return out
+
+
+def scenario_fault_counters() -> dict:
+    """``hang_subprocess:K`` fires exactly K times across N threads."""
+    from raft_tpu.resilience import faults
+
+    out: dict = {}
+    k_budget = 5
+    old = os.environ.get("RAFT_TPU_FAULT_INJECT")
+    os.environ["RAFT_TPU_FAULT_INJECT"] = f"hang_subprocess:{k_budget}"
+    faults.reset_counts()
+    fires = [0] * THREADS
+
+    def worker(i):
+        n = 0
+        for _ in range(200):
+            if faults.consume("hang_subprocess"):
+                n += 1
+        fires[i] = n
+
+    try:
+        errors = _run_threads(THREADS, worker)
+    finally:
+        if old is None:
+            os.environ.pop("RAFT_TPU_FAULT_INJECT", None)
+        else:
+            os.environ["RAFT_TPU_FAULT_INJECT"] = old
+        faults.reset_counts()
+    _check(out, "no_errors", not errors, "; ".join(errors))
+    _check(out, "exact_fires", sum(fires) == k_budget,
+           f"{sum(fires)} fires != budget {k_budget}")
+    out["fires"] = sum(fires)
+    return out
+
+
+def main(argv=None) -> int:
+    # the harness must never dial a hardware backend: pin CPU before jax
+    # init, and keep the warm-start layers inside a scratch root
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    t0 = time.perf_counter()
+    old_interval = sys.getswitchinterval()
+    sys.setswitchinterval(SWITCH_INTERVAL)
+    report: dict = {"tool": "race-smoke", "threads": THREADS,
+                    "switch_interval": SWITCH_INTERVAL}
+    try:
+        with tempfile.TemporaryDirectory(prefix="raft_race_") as tmp:
+            report["aot_single_flight"] = scenario_aot_single_flight(
+                os.path.join(tmp, "cache"))
+            report["compile_event_counters"] = scenario_compile_event_counters()
+            report["metrics_spans"] = scenario_metrics_and_spans()
+            report["chunkstore"] = scenario_chunkstore(
+                os.path.join(tmp, "ckpt"))
+            report["fault_counters"] = scenario_fault_counters()
+    finally:
+        sys.setswitchinterval(old_interval)
+    failures = [f for s in report.values() if isinstance(s, dict)
+                for f in s.get("failures", ())]
+    report["elapsed_s"] = round(time.perf_counter() - t0, 2)
+    report["ok"] = not failures
+    if failures:
+        report["failures"] = failures
+    print(json.dumps(report))
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

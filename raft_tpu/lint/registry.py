@@ -25,11 +25,15 @@ from __future__ import annotations
 
 import dataclasses
 import os
+import threading
 from typing import Callable
 
 # (nw, x64-mode) -> staged base model; the audit traces under x32 while
-# the test suite runs x64, so the cache must key on the mode
+# the test suite runs x64, so the cache must key on the mode.  The lock
+# makes the get-or-stage single-flight: parallel audit runners (or a
+# daemon arming entries concurrently) stage each base exactly once.
 _base_cache: dict = {}
+_base_lock = threading.Lock()
 
 
 @dataclasses.dataclass(frozen=True)
@@ -37,6 +41,13 @@ class EntryPoint:
     name: str
     public_api: str                      # the API this entry guards
     build: Callable[[], tuple]           # () -> (fn, args, args2)
+    #: daemon-facing: the public API this entry mirrors is served to
+    #: CONCURRENT callers by the ROADMAP resident solver service, so its
+    #: host path falls under the GL3xx concurrency contracts (GL303 seeds
+    #: come from :data:`CONCURRENT_FUNCTIONS`, which every
+    #: ``concurrent=True`` entry's ``public_api`` must join — pinned by a
+    #: drift test, like the knobs table)
+    concurrent: bool = False
 
 
 def _small_base(nw: int = 6):
@@ -48,14 +59,16 @@ def _small_base(nw: int = 6):
     from raft_tpu.model import stage_design_base
 
     key = (nw, bool(jax.config.jax_enable_x64))
-    hit = _base_cache.get(key)
-    if hit is not None:
-        return hit
-    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    out = stage_design_base(os.path.join(pkg, "designs", "OC3spar.yaml"),
-                            nw=nw, Hs=6.0, Tp=10.0, w_min=0.3, w_max=2.1)
-    _base_cache[key] = out
-    return out
+    with _base_lock:
+        hit = _base_cache.get(key)
+        if hit is not None:
+            return hit
+        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+        out = stage_design_base(os.path.join(pkg, "designs", "OC3spar.yaml"),
+                                nw=nw, Hs=6.0, Tp=10.0, w_min=0.3,
+                                w_max=2.1)
+        _base_cache[key] = out
+        return out
 
 
 _N_ITER = 3     # fixed-point iterations: the audit checks structure, not
@@ -203,34 +216,36 @@ def _entry_sweep_designs():
     import numpy as np
 
     key = ("sweep_designs", bool(jax.config.jax_enable_x64))
-    hit = _base_cache.get(key)
-    if hit is None:
-        from raft_tpu.model import load_design, stage_designs
-        from raft_tpu.build import buckets as _buckets
+    with _base_lock:
+        hit = _base_cache.get(key)
+        if hit is None:
+            from raft_tpu.model import load_design, stage_designs
+            from raft_tpu.build import buckets as _buckets
 
-        pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        path = os.path.join(pkg, "designs", "OC3spar.yaml")
-        variant = copy.deepcopy(load_design(path))
-        # a genuinely different topology in the same bucket: split the
-        # spar's station list (more segments/nodes than stock OC3)
-        m0 = variant["platform"]["members"][0]
-        s0, s1 = float(m0["stations"][0]), float(m0["stations"][-1])
-        m0["stations"] = [s0, 0.5 * (s0 + s1), s1]
-        m0["d"] = [float(np.atleast_1d(m0["d"])[0])] * 3
-        t0 = float(np.atleast_1d(m0["t"])[0])
-        m0["t"] = [t0] * 3
-        staged = stage_designs([path, variant], nw=6, Hs=6.0, Tp=10.0,
-                               w_min=0.3, w_max=2.1)
-        if len(staged) != 1:
-            raise AssertionError(
-                f"audit fixture designs landed in {len(staged)} buckets "
-                f"({list(staged)}); they must share one")
-        (batch,) = staged.values()
-        sig = _buckets.bucketize(load_design(path), nw=6)
-        sig_v = _buckets.bucketize(variant, nw=6)
-        if sig != sig_v:
-            raise AssertionError(f"fixture buckets diverged: {sig} vs {sig_v}")
-        hit = _base_cache[key] = batch
+            pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+            path = os.path.join(pkg, "designs", "OC3spar.yaml")
+            variant = copy.deepcopy(load_design(path))
+            # a genuinely different topology in the same bucket: split the
+            # spar's station list (more segments/nodes than stock OC3)
+            m0 = variant["platform"]["members"][0]
+            s0, s1 = float(m0["stations"][0]), float(m0["stations"][-1])
+            m0["stations"] = [s0, 0.5 * (s0 + s1), s1]
+            m0["d"] = [float(np.atleast_1d(m0["d"])[0])] * 3
+            t0 = float(np.atleast_1d(m0["t"])[0])
+            m0["t"] = [t0] * 3
+            staged = stage_designs([path, variant], nw=6, Hs=6.0, Tp=10.0,
+                                   w_min=0.3, w_max=2.1)
+            if len(staged) != 1:
+                raise AssertionError(
+                    f"audit fixture designs landed in {len(staged)} buckets "
+                    f"({list(staged)}); they must share one")
+            (batch,) = staged.values()
+            sig = _buckets.bucketize(load_design(path), nw=6)
+            sig_v = _buckets.bucketize(variant, nw=6)
+            if sig != sig_v:
+                raise AssertionError(
+                    f"fixture buckets diverged: {sig} vs {sig_v}")
+            hit = _base_cache[key] = batch
     batch = hit
 
     from raft_tpu.parallel.sweep import forward_response
@@ -272,9 +287,9 @@ def _entry_eigen():
 
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("north_star_sweep", "raft_tpu.parallel.sweep.sweep",
-               _entry_north_star_sweep),
+               _entry_north_star_sweep, concurrent=True),
     EntryPoint("dlc_solve", "raft_tpu.parallel.sweep.sweep_sea_states",
-               _entry_dlc_solve),
+               _entry_dlc_solve, concurrent=True),
     EntryPoint("freq_sharded_forward",
                "raft_tpu.parallel.sweep.forward_response_freq_sharded",
                _entry_freq_sharded),
@@ -285,7 +300,23 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
                "raft_tpu.core.pallas6.solve_rao_pallas",
                _entry_fused_rao_solve),
     EntryPoint("sweep_designs", "raft_tpu.parallel.sweep.sweep_designs",
-               _entry_sweep_designs),
+               _entry_sweep_designs, concurrent=True),
+)
+
+#: the daemon-facing host functions whose whole call path falls under the
+#: GL3xx concurrency contracts — graftlint's GL303 seeds its concurrent
+#: reachability here.  Every ``concurrent=True`` audit entry's
+#: ``public_api`` is included automatically (the solve/sweep/DLC request
+#: handlers of the ROADMAP resident service); the cache registry entry
+#: points join explicitly because a daemon also arms executables outside
+#: any sweep call.  Names must resolve to real callables AND be listed in
+#: the docs "Concurrency contracts" section (``tests/test_lint.py``
+#: drift-pins both directions, the knobs table==registry precedent).
+CONCURRENT_FUNCTIONS: tuple[str, ...] = tuple(
+    e.public_api for e in ENTRY_POINTS if e.concurrent
+) + (
+    "raft_tpu.cache.aot.cached_compile",
+    "raft_tpu.cache.aot.cached_callable",
 )
 
 

@@ -55,6 +55,26 @@ GL204    donation-contract     ``donate_argnums``/``donate_argnames`` on a
                                argument index that does not exist at the
                                call site / a function with no output to
                                alias
+GL301    unlocked-global-      bare mutation of module-global mutable state
+         mutation              (dict/list/set/deque subscript-assign,
+                               ``.append``/``.clear``/``+=``/...) inside a
+                               function, outside any ``with <lock>:`` block
+                               — a resident multi-threaded daemon interleaves
+                               such writes (the PR 11 span-stack lesson)
+GL302    check-then-act-memo   ``if k not in d: d[k] = ...`` (or
+                               ``d.get(k)``-then-assign) on a module-global
+                               dict without a lock — the in-process memo
+                               pattern that double-computes (double-COMPILES,
+                               for the AOT memo) under concurrent requests
+GL303    env-read-in-          an env-knob read inside code reachable from a
+         concurrent-path       registered *concurrent* entry point
+                               (``lint/registry.py`` ``concurrent=True`` /
+                               ``CONCURRENT_FUNCTIONS``, or an in-module
+                               ``__graftlint_concurrent__`` declaration): a
+                               resident process must snapshot knobs at arm
+                               time — a mid-process env change silently
+                               diverges behavior from the AOT key it was
+                               salted into
 =======  ====================  ==============================================
 
 Reachability: a function is *jit-reachable* when it is decorated with (or
@@ -95,7 +115,29 @@ RULES = {
     "GL202": "non-atomic-publish",
     "GL203": "unbounded-subprocess",
     "GL204": "donation-contract",
+    "GL301": "unlocked-global-mutation",
+    "GL302": "check-then-act-memo",
+    "GL303": "env-read-in-concurrent-path",
 }
+
+# ---------------------------------------------------------------- GL3xx --
+# constructors whose module-level result is shared mutable state the
+# concurrency contract (docs/architecture.rst "Concurrency contracts")
+# applies to: locked, thread-local, or suppressed-with-reason
+_MUTABLE_CONSTRUCTORS = {"dict", "list", "set", "deque", "Counter",
+                         "defaultdict", "OrderedDict"}
+
+# in-place mutators of those containers (reads are free; rebinding a
+# module global needs an explicit ``global`` and rides the AugAssign arm)
+_MUTATOR_METHODS = {"append", "appendleft", "extend", "extendleft",
+                    "insert", "add", "discard", "remove", "pop",
+                    "popitem", "popleft", "clear", "update", "setdefault",
+                    "sort", "reverse", "subtract"}
+
+#: module-level declaration marking functions as concurrent entry points
+#: for GL303 (the in-file analog of ``lint/registry.py``'s
+#: ``CONCURRENT_FUNCTIONS`` — a daemon module declares its own handlers)
+CONCURRENT_DECL = "__graftlint_concurrent__"
 
 # the AOT registry's compile entry points: a function handed to one of
 # these is traced and compiled exactly like a jax.jit target (GL1xx
@@ -189,6 +231,7 @@ class FuncInfo:
     static_params: set[str] = dataclasses.field(default_factory=set)
     is_root: bool = False
     reachable: bool = False
+    concurrent: bool = False      # reachable from a concurrent entry point
 
 
 class ModuleInfo:
@@ -220,6 +263,11 @@ class ModuleInfo:
         # module-level NAME = "string" constants (resolves the
         # ``ENV_VAR = "RAFT_TPU_X"; os.environ.get(ENV_VAR)`` spelling)
         self.str_constants: dict[str, str] = {}
+        # module-global mutable containers (GL301/GL302 state-ownership
+        # contract targets) and the module's declared concurrent entry
+        # points (GL303 seeds)
+        self.mutable_globals: set[str] = set()
+        self.concurrent_decls: tuple = ()
         self._collect_suppressions()
         self._collect_imports()
         for node in self.tree.body:
@@ -229,6 +277,43 @@ class ModuleInfo:
                 for t in node.targets:
                     if isinstance(t, ast.Name):
                         self.str_constants[t.id] = node.value.value
+        self._collect_mutable_globals()
+
+    def _collect_mutable_globals(self) -> None:
+        """Module-level names bound to a mutable container (literal,
+        comprehension, or dict/list/set/deque/Counter/defaultdict call) —
+        the state GL301/GL302 hold to the lock-or-thread-local contract.
+        Module-scope init itself is exempt (the import lock serializes
+        it); only mutations from inside functions are checked."""
+        for node in self.tree.body:
+            targets: list = []
+            value = None
+            if isinstance(node, ast.Assign):
+                targets, value = node.targets, node.value
+            elif isinstance(node, ast.AnnAssign) and node.value is not None:
+                targets, value = [node.target], node.value
+            if value is None:
+                continue
+            names = [t.id for t in targets if isinstance(t, ast.Name)]
+            if not names:
+                continue
+            if CONCURRENT_DECL in names:
+                self.concurrent_decls = tuple(
+                    n.value for n in ast.walk(value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str))
+                continue
+            mutable = isinstance(value, (ast.Dict, ast.List, ast.Set,
+                                         ast.DictComp, ast.ListComp,
+                                         ast.SetComp))
+            if not mutable and isinstance(value, ast.Call):
+                fn = value.func
+                ctor = (fn.id if isinstance(fn, ast.Name)
+                        else fn.attr if isinstance(fn, ast.Attribute)
+                        else None)
+                mutable = ctor in _MUTABLE_CONSTRUCTORS
+            if mutable:
+                self.mutable_globals.update(names)
 
     # -- suppressions ---------------------------------------------------
     def _collect_suppressions(self) -> None:
@@ -757,9 +842,11 @@ class Analyzer:
     # -- rule application -------------------------------------------------
     def run(self) -> list[Violation]:
         self.propagate()
+        self._propagate_concurrent()
         for mod in self.modules.values():
             self._check_module_wide(mod)
             self._check_contracts(mod)
+            self._check_concurrency(mod)
             for fi in mod.functions.values():
                 if fi.reachable:
                     self._check_traced_function(fi)
@@ -923,6 +1010,7 @@ class Analyzer:
         for scope, node in self._scoped_nodes(mod):
             qual = scope.qualname if scope else "<module>"
             self._gl201_env_read(mod, scope, node, qual)
+            self._gl303_env_read(mod, scope, node, qual)
             if isinstance(node, ast.Call):
                 self._gl203_subprocess(mod, node, qual)
                 self._gl204_donation(mod, node, qual)
@@ -1029,6 +1117,218 @@ class Analyzer:
                                 f"donate_argnums {i} is out of range for "
                                 f"the {nargs}-argument call site — there "
                                 f"is no input buffer to alias")
+
+    # ---- concurrency contract rules: GL301, GL302, GL303 ----
+    def _propagate_concurrent(self) -> None:
+        """Mark every function host-reachable from a registered concurrent
+        entry point (the ROADMAP daemon's request path).  Seeds come from
+        ``lint/registry.py``'s ``CONCURRENT_FUNCTIONS`` (dotted names) and
+        from in-module ``__graftlint_concurrent__`` declarations; edges
+        are the same bare-name references the jit reachability uses PLUS
+        module-attribute calls (``_ckpt.store_for(...)``) resolved through
+        the import map — a daemon request path crosses modules that way."""
+        roots: set = set()
+        try:
+            from raft_tpu.lint import registry as _registry
+
+            roots.update(getattr(_registry, "CONCURRENT_FUNCTIONS", ()))
+        except Exception:       # linting outside the package install
+            pass
+        work: list[FuncInfo] = []
+
+        def mark(fi: FuncInfo | None) -> None:
+            if fi is not None and not fi.concurrent:
+                fi.concurrent = True
+                work.append(fi)
+
+        for dotted_mod, mod in self.modules.items():
+            for fname in mod.concurrent_decls:
+                mark(mod.functions.get(fname))
+            for r in roots:
+                if r.startswith(dotted_mod + "."):
+                    mark(mod.functions.get(r[len(dotted_mod) + 1:]))
+        while work:
+            fi = work.pop()
+            for callee in self._referenced_functions(fi):
+                mark(callee)
+            for callee in self._attr_referenced_functions(fi):
+                mark(callee)
+
+    def _attr_referenced_functions(self, fi: FuncInfo):
+        """Functions referenced as ``module_alias.func`` from ``fi``'s
+        body, resolved through the import map to analyzed modules
+        (package ``__init__`` re-exports chased by prefix search)."""
+        mod = fi.module
+        for node in self._own_body_walk(fi):
+            if not (isinstance(node, ast.Attribute)
+                    and isinstance(node.value, ast.Name)
+                    and isinstance(node.ctx, ast.Load)):
+                continue
+            tgt = mod.import_map.get(node.value.id)
+            if tgt is None:
+                continue
+            dotted = tgt[0] if tgt[1] is None else f"{tgt[0]}.{tgt[1]}"
+            m2 = self.modules.get(dotted)
+            if m2 is not None:
+                hit = m2.functions.get(node.attr)
+                if hit is not None:
+                    yield hit
+                    continue
+            for dn, m3 in self.modules.items():
+                if dn.startswith(dotted + "."):
+                    hit = m3.functions.get(node.attr)
+                    if hit is not None:
+                        yield hit
+
+    def _gl303_env_read(self, mod: ModuleInfo, scope: FuncInfo | None,
+                        node: ast.AST, qual: str) -> None:
+        if scope is None or not scope.concurrent:
+            return
+        name = mod.env_read_name(node)
+        if name is None or not _knobs.ENV_READ_RE.match(name):
+            return
+        self._emit(mod, "GL303", node, qual,
+                   f"env knob {name!r} is read inside {qual}(), which is "
+                   f"reachable from a registered concurrent entry point: "
+                   f"a resident process must snapshot knobs at arm time — "
+                   f"a mid-process env change silently diverges behavior "
+                   f"from the AOT key it was salted into; hoist the read "
+                   f"to arm/configuration time, or triage with the "
+                   f"single-threaded-by-contract reason")
+
+    def _check_concurrency(self, mod: ModuleInfo) -> None:
+        """GL301/GL302 over every function: module-global mutable state
+        must be mutated under a lock (``with <lock>:`` lexically
+        enclosing), be ``threading.local`` (attribute stores on it are
+        not container mutations and pass), or carry a suppression naming
+        the single-threaded contract.  Module-scope init is exempt — the
+        import lock serializes it."""
+        if not mod.mutable_globals:
+            return
+        for fi in mod.functions.values():
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            self._check_gl30x_function(mod, fi)
+
+    def _check_gl30x_function(self, mod: ModuleInfo, fi: FuncInfo) -> None:
+        bound = _locally_bound(fi)
+        qual = fi.qualname
+
+        def global_name(n: ast.AST) -> str | None:
+            if isinstance(n, ast.Name) and n.id in mod.mutable_globals \
+                    and n.id not in bound:
+                return n.id
+            return None
+
+        # globals this function STORES into (subscript assign / mutator
+        # method), at any lock depth — the GL302 ``.get``-then-assign arm
+        # only fires when the check-then-act really acts on the dict
+        stored: set = set()
+        for node in self._own_body_walk(fi):
+            if isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if isinstance(t, ast.Subscript):
+                        g = global_name(t.value)
+                        if g:
+                            stored.add(g)
+            elif isinstance(node, ast.Call) and isinstance(
+                    node.func, ast.Attribute) and \
+                    node.func.attr in _MUTATOR_METHODS:
+                g = global_name(node.func.value)
+                if g:
+                    stored.add(g)
+
+        def check(node: ast.AST, locked: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return      # own FuncInfo; a lexical lock does not transfer
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held = locked or any(_is_lockish(it.context_expr)
+                                     for it in node.items)
+                for it in node.items:
+                    check(it.context_expr, locked)
+                for child in node.body:
+                    check(child, held)
+                return
+            if not locked:
+                self._gl301_mutation(mod, fi, node, global_name, qual)
+                self._gl302_check_then_act(mod, node, global_name, stored,
+                                           qual)
+            for child in ast.iter_child_nodes(node):
+                check(child, locked)
+
+        for stmt in fi.node.body:
+            check(stmt, False)
+
+    def _gl301_mutation(self, mod: ModuleInfo, fi: FuncInfo, node: ast.AST,
+                        global_name, qual: str) -> None:
+        g = kind = None
+        if isinstance(node, (ast.Assign, ast.AugAssign)):
+            targets = (node.targets if isinstance(node, ast.Assign)
+                       else [node.target])
+            for t in targets:
+                if isinstance(t, ast.Subscript) and global_name(t.value):
+                    g, kind = global_name(t.value), "subscript-assign"
+                elif isinstance(node, ast.AugAssign) and global_name(t):
+                    g, kind = global_name(t), "augmented-assign"
+        elif isinstance(node, ast.Delete):
+            for t in node.targets:
+                if isinstance(t, ast.Subscript) and global_name(t.value):
+                    g, kind = global_name(t.value), "del"
+        elif isinstance(node, ast.Call) and isinstance(node.func,
+                                                       ast.Attribute) \
+                and node.func.attr in _MUTATOR_METHODS:
+            if global_name(node.func.value):
+                g = global_name(node.func.value)
+                kind = f".{node.func.attr}()"
+        if g is not None:
+            self._emit(mod, "GL301", node, qual,
+                       f"bare {kind} mutation of module-global {g!r} in "
+                       f"{qual}() outside any lock: a multi-threaded "
+                       f"resident process interleaves these writes — "
+                       f"guard with `with <lock>:`, make the state "
+                       f"threading.local, or suppress naming the "
+                       f"single-threaded contract")
+
+    def _gl302_check_then_act(self, mod: ModuleInfo, node: ast.AST,
+                              global_name, stored: set, qual: str) -> None:
+        # form 1: `if k not in d:` with a d[...] = store in the body
+        if isinstance(node, ast.If) and isinstance(node.test, ast.Compare) \
+                and len(node.test.ops) == 1 \
+                and isinstance(node.test.ops[0], ast.NotIn):
+            g = global_name(node.test.comparators[0])
+            if g:
+                acts = any(
+                    isinstance(n, ast.Assign) and any(
+                        isinstance(t, ast.Subscript)
+                        and global_name(t.value) == g
+                        for t in n.targets)
+                    for b in node.body for n in ast.walk(b))
+                if acts:
+                    self._emit(mod, "GL302", node, qual,
+                               f"check-then-act memoization on "
+                               f"module-global {g!r}: `if k not in "
+                               f"{g}: {g}[k] = ...` double-computes "
+                               f"under concurrent callers — hold one "
+                               f"lock across the check AND the insert "
+                               f"(single-flight)")
+            return
+        # form 2: an unlocked `d.get(k)` in a function that also stores
+        # into d — the AOT-memo get-or-compute shape
+        if isinstance(node, ast.Call) and isinstance(node.func,
+                                                     ast.Attribute) \
+                and node.func.attr == "get":
+            g = global_name(node.func.value)
+            if g and g in stored:
+                self._emit(mod, "GL302", node, qual,
+                           f"{g}.get(...) outside a lock in {qual}(), "
+                           f"which also stores into {g!r}: the "
+                           f"get-or-compute races a concurrent insert "
+                           f"(double compile / lost update) — hold one "
+                           f"lock across check and act, or single-flight "
+                           f"the compute")
 
     def _module_level_nodes(self, mod: ModuleInfo):
         """Module-scope statements (function/lambda bodies excluded —
@@ -1345,6 +1645,61 @@ def _target_names(t: ast.AST):
         yield from _target_names(t.value)
     elif isinstance(t, (ast.Subscript, ast.Attribute)):
         yield from _target_names(t.value)
+
+
+def _is_lockish(expr: ast.AST) -> bool:
+    """Is ``with <expr>:`` a lock acquisition?  Judged by the terminal
+    identifier (``_lock``, ``self._lock``, ``cv``-style names excluded):
+    any name mentioning lock/mutex, plus the threading synchronization
+    constructors — the module convention every guarded global in this
+    package already follows (``_lock = threading.Lock()``)."""
+    node = expr
+    if isinstance(node, ast.Call):
+        node = node.func
+    name = (node.attr if isinstance(node, ast.Attribute)
+            else node.id if isinstance(node, ast.Name) else None)
+    if name is None:
+        return False
+    low = name.lower()
+    return ("lock" in low or "mutex" in low
+            or name in ("Condition", "Semaphore", "BoundedSemaphore"))
+
+
+def _bound_target_names(t: ast.AST):
+    """Names a target BINDS (unlike :func:`_target_names`, a subscript or
+    attribute store does not bind — ``d[k] = v`` mutates, not binds)."""
+    if isinstance(t, ast.Name):
+        yield t.id
+    elif isinstance(t, (ast.Tuple, ast.List)):
+        for e in t.elts:
+            yield from _bound_target_names(e)
+    elif isinstance(t, ast.Starred):
+        yield from _bound_target_names(t.value)
+
+
+def _locally_bound(fi: FuncInfo) -> set:
+    """Names shadowing a module global inside ``fi``: parameters plus
+    every locally-bound name, minus explicit ``global`` declarations."""
+    bound = set(fi.params)
+    global_decls: set = set()
+    if isinstance(fi.node, ast.Lambda):
+        return bound
+    for node in Analyzer._own_body_walk(fi):
+        if isinstance(node, ast.Global):
+            global_decls.update(node.names)
+        elif isinstance(node, ast.Assign):
+            for t in node.targets:
+                bound.update(_bound_target_names(t))
+        elif isinstance(node, (ast.AnnAssign, ast.AugAssign,
+                               ast.NamedExpr)):
+            bound.update(_bound_target_names(node.target))
+        elif isinstance(node, ast.For):
+            bound.update(_bound_target_names(node.target))
+        elif isinstance(node, ast.withitem) and node.optional_vars:
+            bound.update(_bound_target_names(node.optional_vars))
+        elif isinstance(node, ast.comprehension):
+            bound.update(_bound_target_names(node.target))
+    return bound - global_decls
 
 
 def _dotted_name(relpath: str) -> str:

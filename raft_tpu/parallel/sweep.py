@@ -13,6 +13,7 @@ embarrassingly parallel — the mesh axis is pure data parallelism over ICI.
 """
 from __future__ import annotations
 
+import threading
 import time
 
 import numpy as np
@@ -711,25 +712,30 @@ def _dlc_lane_solver(members, rna, env, C_moor, staged, waves, lane_F):
     # rung knobs fully determine the program — memoized here so the
     # "a rung used twice compiles once" contract holds even with the
     # warm-start cache disabled (where cached_callable returns a fresh
-    # jax.jit per call)
+    # jax.jit per call).  Single-flight under the lock: concurrent lane
+    # salvages (a daemon serving requests in threads) build each rung
+    # exactly once instead of racing the get-or-compute.
     rung_fns: dict = {}
+    rung_lock = threading.Lock()
 
     def solve_lane(idx, n_iter_r, relax_r, tik_r):
         wv = WaveState(
             w=waves.w[idx], k=waves.k[idx], zeta=waves.zeta[idx],
             beta=None if waves.beta is None else waves.beta[idx])
         F_re_i, F_im_i = lane_F(idx)
-        fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
-        if fn1 is None:
-            one_r = _make_dlc_case_fn(members, rna, env, C_moor, staged,
-                                      n_iter_r, relax=relax_r, tik=tik_r,
-                                      health=True)
-            fn1 = _cache.cached_callable(
-                "resilience.ladder.dlc", one_r, (wv, F_re_i, F_im_i),
-                consts=(members, rna, env, C_moor, staged or ()),
-                extra=("n_iter", n_iter_r, "relax", relax_r, "tik", tik_r),
-            )
-            rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
+        with rung_lock:
+            fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
+            if fn1 is None:
+                one_r = _make_dlc_case_fn(members, rna, env, C_moor, staged,
+                                          n_iter_r, relax=relax_r,
+                                          tik=tik_r, health=True)
+                fn1 = _cache.cached_callable(
+                    "resilience.ladder.dlc", one_r, (wv, F_re_i, F_im_i),
+                    consts=(members, rna, env, C_moor, staged or ()),
+                    extra=("n_iter", n_iter_r, "relax", relax_r,
+                           "tik", tik_r),
+                )
+                rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
         abs2_i, a_i, it_i, conv_i, fin_i = fn1(wv, F_re_i, F_im_i)
         # host-side by contract: fn1 is the compiled rung executable,
         # this driver fetches its outputs for the quarantine bookkeeping
@@ -1104,29 +1110,33 @@ def sweep(
         return res
 
     thetas_np = np.asarray(thetas)
-    rung_fns: dict = {}   # one executable per rung even with cache off
+    # one executable per rung even with cache off; single-flight under
+    # the lock against concurrent lane salvages
+    rung_fns: dict = {}
+    rung_lock = threading.Lock()
 
     def solve_lane(idx, n_iter_r, relax_r, tik_r):
         th = jnp.asarray(thetas_np[idx])
-        fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
-        if fn1 is None:
-            def f(theta, _n=n_iter_r, _r=relax_r, _t=tik_r):
-                m = apply_fn(members, theta)
-                out = forward_response(m, rna, env, wave, C_moor,
-                                       n_iter=_n, relax=_r, tik=_t)
-                abs2 = out.Xi.abs2()
-                stat = abs2 if return_xi else response_std(abs2, wave.w)
-                return (stat, out.n_iter, out.converged,
-                        jnp.isfinite(abs2).all())
+        with rung_lock:
+            fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
+            if fn1 is None:
+                def f(theta, _n=n_iter_r, _r=relax_r, _t=tik_r):
+                    m = apply_fn(members, theta)
+                    out = forward_response(m, rna, env, wave, C_moor,
+                                           n_iter=_n, relax=_r, tik=_t)
+                    abs2 = out.Xi.abs2()
+                    stat = abs2 if return_xi else response_std(abs2, wave.w)
+                    return (stat, out.n_iter, out.converged,
+                            jnp.isfinite(abs2).all())
 
-            fn1 = _cache.cached_callable(
-                "resilience.ladder.sweep", f, (th,),
-                consts=(members, rna, env, wave, C_moor),
-                extra=("n_iter", n_iter_r, "relax", relax_r, "tik", tik_r,
-                       "return_xi", bool(return_xi),
-                       *_cache.callable_salt(apply_fn)),
-            )
-            rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
+                fn1 = _cache.cached_callable(
+                    "resilience.ladder.sweep", f, (th,),
+                    consts=(members, rna, env, wave, C_moor),
+                    extra=("n_iter", n_iter_r, "relax", relax_r,
+                           "tik", tik_r, "return_xi", bool(return_xi),
+                           *_cache.callable_salt(apply_fn)),
+                )
+                rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
         stat, it, conv_i, fin_i = fn1(th)
         return ((np.asarray(stat), np.asarray(it)),
                 bool(np.asarray(conv_i)), bool(np.asarray(fin_i)),
@@ -1284,26 +1294,31 @@ def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
     if not health:
         return res
 
-    rung_fns: dict = {}   # one executable per rung even with cache off
+    # one executable per rung even with cache off; single-flight under
+    # the lock against concurrent lane salvages
+    rung_fns: dict = {}
+    rung_lock = threading.Lock()
 
     def solve_lane(idx, n_iter_r, relax_r, tik_r):
         lane = jax.tree_util.tree_map(lambda a: a[idx], args[:5])
         lane_bem = (jax.tree_util.tree_map(lambda a: a[idx], batch.bem)
                     if has_bem else bem_arg)
-        fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
-        if fn1 is None:
-            # the rung re-traces `one` (the batch body) with the rung's
-            # knobs, so a salvage solve cannot drift from the batch solve
-            def g(m_i, r_i, e_i, w_i, c_i, b_i, _n=n_iter_r, _r=relax_r,
-                  _t=tik_r):
-                return one(m_i, r_i, e_i, w_i, c_i, b_i,
-                           _n=_n, _relax=_r, _tik=_t)
+        with rung_lock:
+            fn1 = rung_fns.get((n_iter_r, relax_r, tik_r))
+            if fn1 is None:
+                # the rung re-traces `one` (the batch body) with the
+                # rung's knobs, so a salvage solve cannot drift from the
+                # batch solve
+                def g(m_i, r_i, e_i, w_i, c_i, b_i, _n=n_iter_r,
+                      _r=relax_r, _t=tik_r):
+                    return one(m_i, r_i, e_i, w_i, c_i, b_i,
+                               _n=_n, _relax=_r, _tik=_t)
 
-            fn1 = _cache.cached_callable(
-                "resilience.ladder.designs", g, (*lane, lane_bem),
-                extra=(*extra, "rung_n", n_iter_r, "relax", relax_r,
-                       "tik", tik_r))
-            rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
+                fn1 = _cache.cached_callable(
+                    "resilience.ladder.designs", g, (*lane, lane_bem),
+                    extra=(*extra, "rung_n", n_iter_r, "relax", relax_r,
+                           "tik", tik_r))
+                rung_fns[(n_iter_r, relax_r, tik_r)] = fn1
         stat, it, conv_i, fin_i = fn1(*lane, lane_bem)
         return ((np.asarray(stat), np.asarray(it)),
                 bool(np.asarray(conv_i)), bool(np.asarray(fin_i)),

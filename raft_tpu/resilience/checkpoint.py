@@ -33,6 +33,7 @@ import hashlib
 import json
 import os
 import tempfile
+import threading
 
 import numpy as np
 
@@ -89,6 +90,11 @@ class ChunkStore:
         self.dir = os.path.join(base, key)
         os.makedirs(self.dir, exist_ok=True)
         self._manifest_path = os.path.join(self.dir, "manifest.json")
+        # per-store lock around every manifest read-modify-write (entry
+        # update + atomic replace as one critical section): two threads
+        # checkpointing chunks concurrently can never drop each other's
+        # entries by racing the whole-file rewrite
+        self._lock = threading.Lock()
         self.saved = 0
         self.resumed = 0
         self.corrupt = 0
@@ -141,11 +147,13 @@ class ChunkStore:
         from raft_tpu.resilience import faults
 
         faults.maybe_corrupt_file("corrupt_ckpt", k, path)
-        self._manifest["chunks"][str(int(k))] = {
-            "sha": _leaf_hash(leaves), "n": len(leaves), "scalar": scalar,
-        }
-        self._write_manifest()
-        self.saved += 1
+        with self._lock:
+            self._manifest["chunks"][str(int(k))] = {
+                "sha": _leaf_hash(leaves), "n": len(leaves),
+                "scalar": scalar,
+            }
+            self._write_manifest()
+            self.saved += 1
         from raft_tpu import obs as _obs
 
         _obs.metrics.counter("ckpt.saved").inc()
@@ -156,22 +164,24 @@ class ChunkStore:
         warnings.warn(
             f"checkpoint chunk {k} of {self.key} is unusable ({why}); "
             f"it will be recomputed", stacklevel=3)
-        self.corrupt += 1
         from raft_tpu import obs as _obs
 
         _obs.metrics.counter("ckpt.corrupt").inc()
-        self._manifest["chunks"].pop(str(int(k)), None)
-        try:
-            os.unlink(self._chunk_path(k))
-        except OSError:
-            pass
-        self._write_manifest()
+        with self._lock:
+            self.corrupt += 1
+            self._manifest["chunks"].pop(str(int(k)), None)
+            try:
+                os.unlink(self._chunk_path(k))
+            except OSError:
+                pass
+            self._write_manifest()
 
     def load(self, k: int):
         """Chunk ``k``'s stored result, or None (missing or corrupt —
         a corrupt artifact is detected by content hash, logged, deleted,
         and counted; it is NEVER returned)."""
-        entry = self._manifest["chunks"].get(str(int(k)))
+        with self._lock:
+            entry = self._manifest["chunks"].get(str(int(k)))
         if entry is None:
             return None
         try:
@@ -183,7 +193,8 @@ class ChunkStore:
         if _leaf_hash(leaves) != entry["sha"]:
             self._drop(k, "content hash mismatch")
             return None
-        self.resumed += 1
+        with self._lock:
+            self.resumed += 1
         from raft_tpu import obs as _obs
 
         _obs.metrics.counter("ckpt.resumed").inc()

@@ -38,6 +38,7 @@ untouched by the harness.
 from __future__ import annotations
 
 import os
+import threading
 
 import numpy as np
 
@@ -45,8 +46,11 @@ import numpy as np
 #: shells/python codes so the smoke can assert the kill really fired)
 KILL_EXIT = 77
 
-# per-process consumption counters for counted faults (hang_subprocess:N)
+# per-process consumption counters for counted faults (hang_subprocess:N);
+# locked so ``name:N`` fires exactly N times even under concurrent
+# subprocess launches (`make race-smoke` pins the exact count)
 _counts: dict = {}
+_counts_lock = threading.Lock()
 
 
 def specs() -> dict:
@@ -105,16 +109,18 @@ def consume(name: str) -> bool:
     n = args[0]
     if n is None:
         return True
-    used = _counts.get(name, 0)
-    if used < n:
-        _counts[name] = used + 1
-        return True
+    with _counts_lock:          # check-then-act atomically: exactly N fires
+        used = _counts.get(name, 0)
+        if used < n:
+            _counts[name] = used + 1
+            return True
     return False
 
 
 def reset_counts() -> None:
     """Forget counted-fault consumption (tests)."""
-    _counts.clear()
+    with _counts_lock:
+        _counts.clear()
 
 
 def nan_results(result):

@@ -365,12 +365,150 @@ def test_compile_event_log_is_bounded_counters_exact():
     try:
         n = aot._COMPILE_EVENTS_MAX + 50
         for i in range(n):
-            aot._compile_events.append("ring")
-            aot._compile_counts["ring"] += 1
+            aot._record_compile("ring")
         assert len(aot.compile_events()) == aot._COMPILE_EVENTS_MAX
         assert aot.compile_count("ring") == n
     finally:
         aot.reset_compile_events()
+
+
+def test_compile_count_reset_consistent_under_threads():
+    """Ring and counter move under ONE lock: a reset racing appends can
+    never leave a negative or torn window, and an uncontended phase
+    counts exactly (test_test_cache-style threaded pin of the PR's
+    events-lock fix)."""
+    import threading
+
+    aot.reset_compile_events()
+    writers, per_writer = 4, 2000
+    stop = threading.Event()
+    bad: list = []
+
+    def writer():
+        for _ in range(per_writer):
+            aot._record_compile("thr_evt")
+
+    def resetter():
+        while not stop.is_set():
+            aot.reset_compile_events()
+            # tear invariant (single resetter, so no clear lands between
+            # these two reads): every ring event carried its increment
+            # atomically, and the count is monotone between resets, so a
+            # count read AFTER the ring read can never be smaller.  The
+            # pre-fix non-atomic reset orphaned the events appended
+            # between ring.clear() and counts.clear(), making
+            # count < len(ring) observable.
+            n_ring = len(aot.compile_events("thr_evt"))
+            c = aot.compile_count("thr_evt")
+            if c < n_ring:
+                bad.append((c, n_ring))
+
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        rt = threading.Thread(target=resetter)
+        ts = [threading.Thread(target=writer) for _ in range(writers)]
+        rt.start()
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        stop.set()
+        rt.join()
+        assert not bad, f"torn compile counts observed: {bad[:5]}"
+        aot.reset_compile_events()
+        assert aot.compile_count() == 0 and aot.compile_events() == []
+        # no concurrent reset: the count must be exact
+        ts = [threading.Thread(target=writer) for _ in range(writers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+        assert aot.compile_count("thr_evt") == writers * per_writer
+        assert len(aot.compile_events("thr_evt")) == min(
+            writers * per_writer, aot._COMPILE_EVENTS_MAX)
+    finally:
+        sys.setswitchinterval(old)
+        aot.reset_compile_events()
+
+
+def test_cached_compile_single_flight_under_contention(warm):
+    """N threads requesting one AOT key compile it exactly once (the
+    single-flight discipline): every caller gets the SAME executable
+    object and compile_count stays 1."""
+    import threading
+
+    args = (jnp.arange(6, dtype=jnp.float32),)
+
+    def fn(x):
+        return x * 3.0 - 1.0
+
+    n = 6
+    results = [None] * n
+    barrier = threading.Barrier(n)
+
+    def worker(i):
+        barrier.wait(timeout=30)
+        results[i] = aot.cached_compile("thr_single_flight", fn, args)
+
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        ts = [threading.Thread(target=worker, args=(i,)) for i in range(n)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert all(r is not None for r in results)
+    assert len({id(r) for r in results}) == 1, "threads got distinct executables"
+    assert aot.compile_count("thr_single_flight") == 1
+    out = np.asarray(results[0](*args))
+    np.testing.assert_allclose(out, np.arange(6, dtype=np.float32) * 3.0 - 1.0)
+
+
+def test_cached_compile_single_flight_leader_failure_retries(warm):
+    """A leader whose build raises must not poison the key: the event is
+    set without a publish and a waiter retries as the new leader."""
+    import threading
+    import time
+
+    args = (jnp.arange(4, dtype=jnp.float32),)
+    calls = {"n": 0}
+    leading = threading.Event()
+
+    def flaky(x):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            # hold single-flight leadership until the follower is queued
+            leading.set()
+            time.sleep(0.3)
+            raise RuntimeError("injected trace failure")
+        return x + 1.0
+
+    errors: list = []
+
+    def leader():
+        try:
+            aot.cached_compile("thr_flaky", flaky, args)
+        except RuntimeError as e:
+            errors.append(str(e))
+
+    results: list = []
+    lt = threading.Thread(target=leader)
+    lt.start()
+    assert leading.wait(timeout=30)     # leader is inside its build now
+    ft = threading.Thread(
+        target=lambda: results.append(
+            aot.cached_compile("thr_flaky", flaky, args)))
+    ft.start()
+    lt.join()
+    ft.join()
+    assert errors == ["injected trace failure"]
+    assert len(results) == 1 and results[0] is not None
+    np.testing.assert_allclose(np.asarray(results[0](*args)),
+                               np.arange(4, dtype=np.float32) + 1.0)
 
 
 def test_cached_callable_off_is_plain_jit():

@@ -230,6 +230,79 @@ FIXTURES = {
                                    jit_kwargs={"donate_argnums": (0,)})
         """,
     ),
+    "GL301": (
+        """
+        import threading
+
+        _memo: dict = {}
+        _lock = threading.Lock()
+
+        def remember(k, v):
+            _memo[k] = v
+        """,
+        """
+        import threading
+
+        _memo: dict = {}
+        _lock = threading.Lock()
+
+        def remember(k, v):
+            with _lock:
+                _memo[k] = v
+
+        def shadowed(k, v):
+            _memo = {}               # local: shadows the module global
+            _memo[k] = v
+            return _memo
+        """,
+    ),
+    "GL302": (
+        """
+        _memo: dict = {}
+
+        def get_or_compute(k):
+            if k not in _memo:
+                _memo[k] = k * 2
+            return _memo[k]
+        """,
+        """
+        import threading
+
+        _memo: dict = {}
+        _lock = threading.Lock()
+
+        def get_or_compute(k):
+            with _lock:              # one lock spans check AND act
+                if k not in _memo:
+                    _memo[k] = k * 2
+                return _memo[k]
+        """,
+    ),
+    "GL303": (
+        """
+        import os
+
+        __graftlint_concurrent__ = ("serve",)
+
+        def serve(req):
+            return req * _depth()
+
+        def _depth():
+            return int(os.environ.get("RAFT_TPU_PIPELINE_DEPTH", "2"))
+        """,
+        """
+        import os
+
+        __graftlint_concurrent__ = ("serve",)
+
+        def serve(req, depth: int):
+            return req * depth
+
+        def arm():
+            # snapshot at arm time, outside the concurrent request path
+            return int(os.environ.get("RAFT_TPU_PIPELINE_DEPTH", "2"))
+        """,
+    ),
 }
 
 
@@ -530,6 +603,174 @@ def test_gl204_keyword_args_after_jit_kwargs(tmp_path):
         """)
     assert any(v.rule == "GL204" and "out of range" in v.msg
                for v in vs), vs
+
+
+# --------------------------------------------------------------------------
+# concurrency contracts: GL301/302/303 edges + entry-point registry drift
+# --------------------------------------------------------------------------
+def test_gl301_mutator_methods_and_augassign(tmp_path):
+    vs = _lint_src(tmp_path, """
+        from collections import deque
+
+        _ring = deque(maxlen=8)
+        _counts: dict = {}
+
+        def record(tag):
+            _ring.append(tag)
+            _counts[tag] = _counts.get(tag, 0) + 1
+
+        def bump(tag):
+            _counts[tag] += 1
+        """)
+    hits = [v for v in vs if v.rule == "GL301"]
+    assert {(v.func, v.line) for v in hits} == {
+        ("record", 8), ("record", 9), ("bump", 12)}, [
+        v.format() for v in vs]
+
+
+def test_gl301_module_level_init_exempt(tmp_path):
+    """Import-time population of a module global is serialized by the
+    import lock — only function-body mutations are contract writes."""
+    vs = _lint_src(tmp_path, """
+        _table: dict = {}
+        for _k in ("a", "b"):
+            _table[_k] = len(_k)
+        """)
+    assert not any(v.rule == "GL301" for v in vs), [
+        v.format() for v in vs]
+
+
+def test_gl301_nested_def_does_not_inherit_lock(tmp_path):
+    """A closure defined inside a `with lock:` block runs LATER, without
+    the lock held — its mutations are bare."""
+    vs = _lint_src(tmp_path, """
+        import threading
+
+        _memo: dict = {}
+        _lock = threading.Lock()
+
+        def make(k):
+            with _lock:
+                def later(v):
+                    _memo[k] = v
+                return later
+        """)
+    assert any(v.rule == "GL301" and "later" in v.func for v in vs), [
+        v.format() for v in vs]
+
+
+def test_gl302_get_then_assign_flagged(tmp_path):
+    """The AOT-memo shape: unlocked d.get(k) in a function that also
+    stores into d."""
+    vs = _lint_src(tmp_path, """
+        _mem: dict = {}
+
+        def get_or_compile(key):
+            hit = _mem.get(key)
+            if hit is None:
+                hit = key * 2
+                _mem[key] = hit
+            return hit
+        """)
+    assert any(v.rule == "GL302" and ".get(" in v.msg for v in vs), [
+        v.format() for v in vs]
+
+
+def test_gl302_readonly_get_not_flagged(tmp_path):
+    """A dict the function never stores into is a read-only lookup —
+    knobs-registry style .get() must stay clean."""
+    vs = _lint_src(tmp_path, """
+        _by_name = {k: k for k in ("a", "b")}
+
+        def lookup(name):
+            return _by_name.get(name)
+        """)
+    assert not any(v.rule == "GL302" for v in vs), [
+        v.format() for v in vs]
+
+
+def test_gl303_crosses_module_attribute_calls(tmp_path):
+    """Concurrent reachability follows module_alias.func edges across
+    files — the daemon request path is spelled that way."""
+    vs = _lint_src(tmp_path, """
+        import helper
+
+        __graftlint_concurrent__ = ("serve",)
+
+        def serve(req):
+            return helper.depth() + req
+        """, extra={"helper.py": """
+        import os
+
+        def depth():
+            return int(os.environ.get("RAFT_TPU_PIPELINE_DEPTH", "2"))
+        """})
+    assert any(v.rule == "GL303" and v.path == "helper.py" for v in vs), [
+        v.format() for v in vs]
+
+
+def test_gl303_repo_seeds_reach_pipeline_knob():
+    """Linting the real package, the registry's concurrent entries must
+    reach the dispatch-ahead executor's env knob read (triaged in the
+    baseline) — the reachability cannot silently go dark."""
+    vs = lint_paths(["raft_tpu"], REPO)
+    assert any(v.rule == "GL303"
+               and v.path == "raft_tpu/parallel/pipeline.py"
+               for v in vs), "GL303 lost the sweep->pipeline edge"
+
+
+def test_concurrent_entry_registry_drift():
+    """Every concurrent=True audit entry rides CONCURRENT_FUNCTIONS,
+    every registered name resolves to a real callable (no zombie
+    flags), and each is named in the docs' Concurrency contracts
+    section — the knobs table==registry precedent."""
+    import importlib
+
+    from raft_tpu.lint import registry
+
+    conc = {e.public_api for e in registry.ENTRY_POINTS if e.concurrent}
+    assert conc, "no concurrent=True entries registered"
+    assert conc <= set(registry.CONCURRENT_FUNCTIONS)
+    for dotted in registry.CONCURRENT_FUNCTIONS:
+        mod_name, fn_name = dotted.rsplit(".", 1)
+        fn = getattr(importlib.import_module(mod_name), fn_name, None)
+        assert callable(fn), f"zombie concurrent flag: {dotted}"
+    docs = open(os.path.join(REPO, "docs", "architecture.rst"),
+                encoding="utf-8").read()
+    assert "Concurrency contracts" in docs
+    for dotted in registry.CONCURRENT_FUNCTIONS:
+        assert dotted in docs, (
+            f"{dotted} missing from docs/architecture.rst "
+            f"'Concurrency contracts'")
+
+
+def test_gl3xx_baseline_reasons_cover_triaged_findings():
+    """Every triaged GL3xx fingerprint carries its justification in the
+    baseline's _reasons map — the zero-unsuppressed-findings bar means
+    triage, and triage means saying why."""
+    data = json.load(open(os.path.join(
+        REPO, "raft_tpu", "lint", "baseline.json")))
+    gl3 = [fp for fp in data["violations"] if fp.startswith("GL3")]
+    reasons = data.get("_reasons", {})
+    missing = [fp for fp in gl3 if not reasons.get(fp, "").strip()]
+    assert not missing, f"GL3xx baseline entries without a reason: {missing}"
+
+
+def test_baseline_save_preserves_reasons(tmp_path):
+    vs = _lint_src(tmp_path, """
+        import numpy as np
+
+        A = np.zeros(2, dtype=np.float64)
+        """)
+    path = str(tmp_path / "baseline.json")
+    bl.save(vs, path)
+    data = json.load(open(path))
+    (fp,) = data["violations"]
+    data["_reasons"] = {fp: "host ABI needs doubles", "stale": "gone"}
+    json.dump(data, open(path, "w"))
+    bl.save(vs, path)       # refresh: surviving reason kept, stale dropped
+    data2 = json.load(open(path))
+    assert data2["_reasons"] == {fp: "host ABI needs doubles"}
 
 
 # --------------------------------------------------------------------------

@@ -381,6 +381,44 @@ def test_chunk_store_detects_truncation_and_bitflips(tmp_path):
     assert st.corrupt == 2 and not st.complete()
 
 
+def test_chunk_store_concurrent_writers_drop_nothing(tmp_path):
+    """Satellite regression (manifest read-modify-write race): two writer
+    threads checkpointing disjoint chunk sets into ONE store must not
+    drop each other's manifest entries — the per-store lock makes the
+    entry-update + atomic-replace one critical section.  Pre-fix this
+    deterministically lost entries (and crashed with 'dictionary changed
+    size during iteration') under a tiny GIL switch interval."""
+    import threading
+
+    n_chunks, writers = 32, 2
+    st = checkpoint.ChunkStore("krace", n_chunks, str(tmp_path))
+
+    def writer(t):
+        for k in range(t, n_chunks, writers):
+            st.save(k, (np.full(8, float(k)), np.array([k, k + 1])))
+
+    old = sys.getswitchinterval()
+    sys.setswitchinterval(1e-6)
+    try:
+        ts = [threading.Thread(target=writer, args=(t,))
+              for t in range(writers)]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join()
+    finally:
+        sys.setswitchinterval(old)
+    assert st.saved == n_chunks and st.complete()
+    # a fresh store (new-process resume) sees EVERY chunk, hash-clean
+    st2 = checkpoint.ChunkStore("krace", n_chunks, str(tmp_path))
+    assert st2.complete()
+    for k in range(n_chunks):
+        out = st2.load(k)
+        assert out is not None, f"chunk {k} lost by the manifest race"
+        np.testing.assert_array_equal(out[0], np.full(8, float(k)))
+    assert st2.corrupt == 0 and st2.resumed == n_chunks
+
+
 def test_chunk_store_ignores_stale_manifest(tmp_path):
     """A store directory left by a different chunking (or a corrupted
     manifest) starts fresh instead of serving mismatched results."""

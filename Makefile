@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke lint lint-budgets
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke serve-smoke lint lint-budgets
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -30,6 +30,9 @@ obs-smoke:       ## observability proof: RAFT_TPU_OBS-armed sweep emits valid
 
 race-smoke:      ## deterministic N-thread race proof: single-flight AOT compile,
 	python -m raft_tpu.lint.race     # exact metric/ckpt/fault counters (< 60 s CPU)
+
+serve-smoke:     ## resident-daemon proof: mixed stream compiles == buckets, parity
+	python -m raft_tpu.serve smoke   # vs solo, SIGTERM -> warm restart 0 compiles
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

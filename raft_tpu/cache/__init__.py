@@ -36,6 +36,7 @@ from raft_tpu.cache.aot import (  # noqa: F401
     compile_count,
     compile_events,
     donation_salt,
+    evict_memory,
     reset_compile_events,
 )
 from raft_tpu.cache.staging import FileKey, cached_arrays, staging_key  # noqa: F401

@@ -58,6 +58,7 @@ from raft_tpu.cache.staging import _update
 _mem: dict = {}
 _mem_lock = threading.Lock()
 _inflight: dict = {}            # key -> threading.Event of the build
+_mem_tags: dict = {}            # key -> tag (scoped eviction, see below)
 
 # tags of executables that were ACTUALLY lowered+compiled in this process
 # (every reuse layer missed) — the evidence stream behind compile-count
@@ -428,6 +429,7 @@ def cached_compile(tag: str, fn, args, *, consts=(), mesh=None,
             _try_store(key, compiled, cold_s)
         with _mem_lock:
             _mem[key] = compiled
+            _mem_tags[key] = tag
         return compiled
     finally:
         with _mem_lock:
@@ -458,4 +460,29 @@ def clear_memory() -> None:
     single-flight entries — the leader publishes into the fresh memo."""
     with _mem_lock:
         _mem.clear()
+        _mem_tags.clear()
     reset_compile_events()
+
+
+def evict_memory(tag: str | None = None) -> int:
+    """Graceful executor refresh for long-lived processes: drop memoized
+    executables (all, or only those registered under ``tag``) WITHOUT
+    touching compile counters or in-flight builds.  The next call per
+    evicted key re-resolves bottom-up — in-process miss, AOT disk load
+    when the program is unchanged, fresh compile when a ladder/knob
+    change re-keyed it — which is exactly the resident solver service's
+    ``refresh`` op: executables turn over without restarting the daemon,
+    and nothing an in-flight batch still references is invalidated (the
+    memo holds plain Python references; eviction only unpins them).
+    Returns the number of entries dropped."""
+    with _mem_lock:
+        if tag is None:
+            n = len(_mem)
+            _mem.clear()
+            _mem_tags.clear()
+            return n
+        keys = [k for k, t in _mem_tags.items() if t == tag]
+        for k in keys:
+            _mem.pop(k, None)
+            _mem_tags.pop(k, None)
+        return len(keys)

@@ -104,6 +104,19 @@ KNOBS: tuple[Knob, ...] = (
          "Internal: marks the re-exec'd device bench child"),
     Knob("RAFT_TPU_DRYRUN_NO_REEXEC", "unset", "__graft_entry__", HOST,
          "Internal: recursion guard of the dryrun subprocess fallback"),
+    # ------------------------------------------------- solver service ----
+    # Snapshotted ONCE at daemon arm time (ServeConfig.from_env — the
+    # GL303 contract); the request path never re-reads them.  BATCH_MAX
+    # fixes the padded lane capacity, which reaches every serve
+    # executable's key through the abstract batch signature the AOT
+    # registry always hashes — no separate salt site needed.
+    Knob("RAFT_TPU_SERVE_BATCH_DEADLINE_MS", "25 ms", "serve.config", HOST,
+         "Micro-batch close deadline of the resident solver service"),
+    Knob("RAFT_TPU_SERVE_BATCH_MAX", "8", "serve.config", HOST,
+         "Fixed padded lane capacity per bucket batch (keyed via the "
+         "abstract batch signature)"),
+    Knob("RAFT_TPU_SERVE_SOCKET", "per-uid tmp path", "serve.config", HOST,
+         "Default AF_UNIX socket path of the solver daemon"),
     # ------------------------------------------------- fault injection ----
     Knob("RAFT_TPU_FAULT_INJECT", "unset", "resilience.faults", FAULT,
          "Deterministic host-side fault specs (nan_chunk:K, kill, ...)"),
@@ -134,9 +147,13 @@ END_MARK = ".. END AUTOGEN KNOB TABLE"
 _AOT_LABEL = {AOT_KEY: "key-salted", HOST: "host-only", FAULT: "fault-inj"}
 
 
-def rst_table() -> str:
+def rst_table(names=None) -> str:
     """The env-knob reference as an RST grid table (list-table), generated
-    so ``docs/usage.rst`` can never drift from the registry."""
+    so the docs can never drift from the registry.  ``names`` filters to a
+    subset (the serving guide renders only the ``RAFT_TPU_SERVE_*`` rows;
+    ``docs/usage.rst`` carries the full table)."""
+    rows = (KNOBS if names is None
+            else tuple(k for k in KNOBS if k.name in set(names)))
     lines = [
         ".. list-table:: Environment knobs (generated from "
         "``raft_tpu/lint/knobs.py``)",
@@ -149,8 +166,8 @@ def rst_table() -> str:
         "     - AOT key",
         "     - Effect",
     ]
-    for k in sorted(KNOBS, key=lambda k: (k.classification != AOT_KEY,
-                                          k.name)):
+    for k in sorted(rows, key=lambda k: (k.classification != AOT_KEY,
+                                         k.name)):
         lines += [
             f"   * - ``{k.name}``",
             f"     - {k.default}",
@@ -161,10 +178,21 @@ def rst_table() -> str:
     return "\n".join(lines) + "\n"
 
 
-def _usage_path() -> str:
+def serve_knob_names() -> tuple:
+    """The resident-solver-service knobs (the ``docs/serving.rst``
+    autogen subset)."""
+    return tuple(k.name for k in KNOBS
+                 if k.name.startswith("RAFT_TPU_SERVE_"))
+
+
+def _docs_path(fname: str) -> str:
     here = os.path.dirname(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))))
-    return os.path.join(here, "docs", "usage.rst")
+    return os.path.join(here, "docs", fname)
+
+
+def _usage_path() -> str:
+    return _docs_path("usage.rst")
 
 
 def rendered_docs_block(text: str) -> str | None:
@@ -178,9 +206,10 @@ def rendered_docs_block(text: str) -> str | None:
     return block.strip("\n") + "\n"
 
 
-def rewrite_docs(path: str | None = None) -> bool:
-    """Regenerate the table between the markers in ``docs/usage.rst``.
-    Returns True when the file changed."""
+def rewrite_docs(path: str | None = None, names=None) -> bool:
+    """Regenerate the table between the markers in one docs file
+    (default ``docs/usage.rst``, full registry).  Returns True when the
+    file changed."""
     path = path or _usage_path()
     with open(path, "r", encoding="utf-8") as f:
         text = f.read()
@@ -188,7 +217,8 @@ def rewrite_docs(path: str | None = None) -> bool:
         raise RuntimeError(f"AUTOGEN markers missing from {path}")
     head, rest = text.split(BEGIN_MARK, 1)
     _old, tail = rest.split(END_MARK, 1)
-    new = head + BEGIN_MARK + "\n\n" + rst_table() + "\n" + END_MARK + tail
+    new = (head + BEGIN_MARK + "\n\n" + rst_table(names) + "\n"
+           + END_MARK + tail)
     if new == text:
         return False
     with open(path, "w", encoding="utf-8") as f:
@@ -196,7 +226,22 @@ def rewrite_docs(path: str | None = None) -> bool:
     return True
 
 
+def rewrite_all_docs() -> list:
+    """Every autogen knob table in the docs tree: the full table in
+    ``usage.rst`` plus the serve subset in ``serving.rst``.  Returns the
+    files that changed (drift tests pin each against the registry)."""
+    changed = []
+    if rewrite_docs(_usage_path()):
+        changed.append("usage.rst")
+    serving = _docs_path("serving.rst")
+    if os.path.exists(serving) and rewrite_docs(serving,
+                                                serve_knob_names()):
+        changed.append("serving.rst")
+    return changed
+
+
 if __name__ == "__main__":
-    changed = rewrite_docs()
-    print(f"[knobs] docs/usage.rst {'updated' if changed else 'up to date'}"
+    changed = rewrite_all_docs()
+    print(f"[knobs] docs tables "
+          f"{'updated: ' + ', '.join(changed) if changed else 'up to date'}"
           f" ({len(KNOBS)} knobs)")

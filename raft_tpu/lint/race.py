@@ -28,6 +28,12 @@ tolerances:
   resumes content-hash-clean in a fresh store, zero corrupt.
 * **fault counters** — ``hang_subprocess:K`` consumed from N threads
   fires exactly K times (the counted-fault check-then-act).
+* **serve micro-batcher** — N submitter threads race the serve loop's
+  queue (two buckets, capacity closes) while one drainer pops batches
+  and a concurrent ``close()`` ends intake: every accepted lane drains
+  EXACTLY once (no lane lost at the submit/close boundary, none
+  duplicated by a double pop), batches never exceed capacity, and
+  per-bucket FIFO order holds within each batch.
 
 ``make race-smoke`` wraps ``python -m raft_tpu.lint.race`` (< 60 s CPU;
 CI fast job, next to the cache/hetero/obs smokes).  Prints one JSON
@@ -310,6 +316,88 @@ def scenario_fault_counters() -> dict:
     return out
 
 
+def scenario_microbatcher() -> dict:
+    """Serve-queue contention: concurrent submit / close / drain (the
+    daemon's reader-threads-vs-solver-loop-vs-SIGTERM triangle)."""
+    from raft_tpu.build.buckets import BucketSig
+    from raft_tpu.serve.batcher import Lane, MicroBatcher
+
+    out: dict = {}
+    sigs = (BucketSig(16, 64, 32), BucketSig(48, 128, 32))
+    cap = 4
+    per_thread = 150
+    # deadline 0: every non-empty bucket is immediately closeable, so the
+    # drainer and the submitters genuinely race the pop/append boundary
+    mb = MicroBatcher(batch_deadline_s=0.0, batch_max=cap)
+    accepted: list = [0] * THREADS
+    batches: list = []
+    drained = threading.Event()
+
+    def drain():
+        while True:
+            item = mb.next_batch()
+            if item is None:
+                drained.set()
+                return
+            batches.append(item)
+
+    drainer = threading.Thread(target=drain, name="race-drain", daemon=True)
+    drainer.start()
+
+    def submit(i):
+        n = 0
+        for j in range(per_thread):
+            lane = Lane(request_id=(i, j), seq=0, label="x", staged=None)
+            try:
+                mb.submit(sigs[j % 2], lane)
+                n += 1
+            except RuntimeError:
+                break           # intake closed underneath us: accounted
+        accepted[i] = n
+
+    # close() races the tail of the submit storm: a few threads' late
+    # submits must either be accepted AND drained, or refused loudly
+    closer = threading.Timer(0.05, mb.close)
+    closer.start()
+    errors = _run_threads(THREADS, submit)
+    closer.join()
+    mb.close()
+    ok_drained = drained.wait(30)
+    drainer.join(10)
+
+    lanes = [ln for _sig, lns in batches for ln in lns]
+    ids = [ln.request_id for ln in lanes]
+    _check(out, "no_errors", not errors, "; ".join(errors))
+    _check(out, "drained", ok_drained, "drain loop did not finish")
+    _check(out, "every_accepted_lane_drained_once",
+           sorted(ids) == sorted(set(ids)) and len(ids) == sum(accepted),
+           f"{len(ids)} drained vs {sum(accepted)} accepted "
+           f"({len(ids) - len(set(ids))} duplicates)")
+    _check(out, "capacity_respected",
+           all(len(lns) <= cap for _s, lns in batches),
+           f"max batch {max((len(l) for _s, l in batches), default=0)}"
+           f" > cap {cap}")
+    fifo_ok = True
+    for _sig, lns in batches:
+        per_src: dict = {}
+        for ln in lns:
+            src, j = ln.request_id
+            if per_src.get(src, -1) >= j:
+                fifo_ok = False
+            per_src[src] = j
+    _check(out, "per_submitter_fifo_within_batch", fifo_ok,
+           "a batch reordered one submitter's lanes")
+    counters = mb.counters()
+    _check(out, "counters_exact",
+           counters["submitted"] == sum(accepted)
+           and counters["popped"] == len(ids)
+           and counters["pending"] == 0,
+           f"batcher counters {counters} vs accepted {sum(accepted)}")
+    out["accepted"] = sum(accepted)
+    out["batches"] = len(batches)
+    return out
+
+
 def main(argv=None) -> int:
     # the harness must never dial a hardware backend: pin CPU before jax
     # init, and keep the warm-start layers inside a scratch root
@@ -328,6 +416,7 @@ def main(argv=None) -> int:
             report["chunkstore"] = scenario_chunkstore(
                 os.path.join(tmp, "ckpt"))
             report["fault_counters"] = scenario_fault_counters()
+            report["serve_microbatcher"] = scenario_microbatcher()
     finally:
         sys.setswitchinterval(old_interval)
     failures = [f for s in report.values() if isinstance(s, dict)

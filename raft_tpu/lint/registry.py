@@ -201,15 +201,10 @@ def _entry_fused_rao_solve():
     return fn, mk(0), mk(1)
 
 
-def _entry_sweep_designs():
-    """Traced core of :func:`raft_tpu.parallel.sweep.sweep_designs` — the
-    shape-bucketed mixed-design megabatch: the per-design arrays (members,
-    RNA, env, wave, mooring) are batch-leading vmapped INPUTS, so one
-    executable serves every design of a bucket class.  The two argument
-    pytrees stack TWO DIFFERENT designs (OC3 spar + a station-split
-    variant with different exact segment/node counts) padded to ONE
-    bucket, in swapped lane order — the zero-retrace budget is exactly
-    the "two different same-bucket designs never recompile" claim."""
+def _two_design_batch():
+    """Shared fixture of the megabatch-shaped entries: TWO genuinely
+    different designs (OC3 spar + a station-split variant with different
+    exact segment/node counts) staged into ONE bucket, batch-leading."""
     import copy
 
     import jax
@@ -246,7 +241,21 @@ def _entry_sweep_designs():
                 raise AssertionError(
                     f"fixture buckets diverged: {sig} vs {sig_v}")
             hit = _base_cache[key] = batch
-    batch = hit
+    return hit
+
+
+def _entry_sweep_designs():
+    """Traced core of :func:`raft_tpu.parallel.sweep.sweep_designs` — the
+    shape-bucketed mixed-design megabatch: the per-design arrays (members,
+    RNA, env, wave, mooring) are batch-leading vmapped INPUTS, so one
+    executable serves every design of a bucket class.  The two argument
+    pytrees stack TWO DIFFERENT designs (OC3 spar + a station-split
+    variant with different exact segment/node counts) padded to ONE
+    bucket, in swapped lane order — the zero-retrace budget is exactly
+    the "two different same-bucket designs never recompile" claim."""
+    import jax
+
+    batch = _two_design_batch()
 
     from raft_tpu.parallel.sweep import forward_response
 
@@ -260,6 +269,44 @@ def _entry_sweep_designs():
     # the SAME two designs in swapped lane order: identical structure and
     # shapes, different values — one trace must serve both
     args2 = jax.tree_util.tree_map(lambda a: a[::-1], args)
+    return fn, args, args2
+
+
+def _entry_serve_solve():
+    """Traced core of :func:`raft_tpu.serve.solver.solve_batch` — the
+    resident service's per-bucket dispatch: the SAME vmapped
+    design-agnostic body as ``sweep_designs``, but padded to the FIXED
+    serve lane capacity (unused lanes tile the real ones).  The two
+    argument pytrees are two different occupancy mixes of the same two
+    same-bucket designs at one capacity — the zero-retrace budget is the
+    serving loop's "every occupancy of a bucket shares one executable"
+    claim, and ``concurrent=True`` puts the whole request path under the
+    GL3xx contracts."""
+    import jax
+
+    batch = _two_design_batch()
+
+    from raft_tpu.parallel.sweep import forward_response, response_std
+
+    def one(members, rna, env, wave, C_moor):
+        out = forward_response(members, rna, env, wave, C_moor,
+                               n_iter=_N_ITER, method="scan")
+        return (response_std(out.Xi.abs2(), wave.w), out.n_iter,
+                out.converged)
+
+    fn = jax.vmap(one)
+    base = (batch.members, batch.rna, batch.env, batch.wave, batch.C_moor)
+
+    import numpy as np
+
+    def pad(args, order):
+        idx = np.asarray(order)
+        return jax.tree_util.tree_map(lambda a: a[idx], args)
+
+    # occupancy 1 (solo, tiled to capacity) vs occupancy 2 (mixed +
+    # one pad lane): identical shapes, different values — one trace
+    args = pad(base, (0, 0, 0))
+    args2 = pad(base, (1, 0, 1))
     return fn, args, args2
 
 
@@ -301,6 +348,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
                _entry_fused_rao_solve),
     EntryPoint("sweep_designs", "raft_tpu.parallel.sweep.sweep_designs",
                _entry_sweep_designs, concurrent=True),
+    EntryPoint("serve_solve", "raft_tpu.serve.solver.solve_batch",
+               _entry_serve_solve, concurrent=True),
 )
 
 #: the daemon-facing host functions whose whole call path falls under the

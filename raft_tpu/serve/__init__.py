@@ -1,0 +1,61 @@
+"""Resident solver service: continuous deadline-bounded request batching.
+
+Every other entry point in this package is a one-shot process: stage,
+compile (or AOT-load), solve, exit.  This subsystem keeps all of that
+machinery RESIDENT — staged design bases, one warm executable per shape
+bucket, the dispatch pipeline — inside a long-lived daemon on a local
+socket, and amortizes it across an arbitrary stream of independent
+clients (the ROADMAP "millions of users" direction).
+
+The moving parts, one module each:
+
+``config``
+    :class:`ServeConfig` — every knob the serve loop consults, snapshotted
+    ONCE at arm time (``RAFT_TPU_SERVE_BATCH_DEADLINE_MS`` /
+    ``RAFT_TPU_SERVE_BATCH_MAX`` / ``RAFT_TPU_SERVE_SOCKET``); the
+    concurrent request path never reads the environment (GL303).
+``protocol``
+    Length-prefixed JSON framing over a local stream socket, plus request
+    validation: ``solve`` (one design x one sea state = one lane),
+    ``dlc`` (one design x N sea states = N lanes), ``sweep`` (N designs
+    x one sea state = N lanes, possibly spanning buckets), ``ping`` /
+    ``stats`` / ``refresh`` / ``shutdown``.
+``batcher``
+    :class:`~raft_tpu.serve.batcher.MicroBatcher` — the deterministic
+    deadline-or-capacity micro-batching core.  Pure queue logic with an
+    injectable clock: the same arrival schedule always closes the same
+    batch compositions (pinned by tests on a virtual clock).
+``solver``
+    :class:`~raft_tpu.serve.solver.SolverCore` — warm staging memo
+    (design x sea state -> bucket-padded lane arrays) and
+    :func:`~raft_tpu.serve.solver.solve_batch`: pad a closed batch to the
+    FIXED lane capacity, solve it through
+    :func:`~raft_tpu.parallel.sweep.sweep_designs` (health + quarantine
+    per lane), and slice per-lane results back to their owning requests.
+``server``
+    The daemon: accept loop, per-connection reader threads, one solver
+    loop draining the batcher, graceful SIGTERM drain.
+``client``
+    :class:`~raft_tpu.serve.client.SolveClient` — async submit/collect
+    over the socket (futures keyed by request id).
+``loadgen``
+    Synthetic OPEN-LOOP load generator with a closed-form arrival
+    schedule (zero wall-clock randomness) and deterministic p50/p99
+    accounting — the bench's ``serving`` block.
+``smoke``
+    ``make serve-smoke``: cross-process proof — mixed 3-design stream,
+    compiles == n_buckets, parity vs solo solves, SIGTERM -> warm
+    restart with ZERO compiles off the AOT disk cache.
+
+Why per-request results cannot depend on batch-mates: every dispatch is
+padded to ``batch_max`` lanes (unused lanes tile the real ones), so ONE
+executable per bucket serves every occupancy, and a lane's values ride a
+vmapped axis whose per-lane program is independent — the same request
+returns bit-identical results whether it shared its batch with zero,
+three, or seven strangers (pinned by tests/test_serve.py).
+"""
+from raft_tpu.serve.config import ServeConfig                     # noqa: F401
+from raft_tpu.serve.batcher import Lane, MicroBatcher             # noqa: F401
+from raft_tpu.serve.solver import SolverCore, solve_batch         # noqa: F401
+from raft_tpu.serve.client import SolveClient                     # noqa: F401
+from raft_tpu.serve.server import SolverServer                    # noqa: F401

@@ -1,0 +1,99 @@
+"""CLI of the resident solver service.
+
+``python -m raft_tpu.serve [daemon] [flags]``
+    Run the daemon in the foreground: arm the warm-start layers, snapshot
+    the ``RAFT_TPU_SERVE_*`` knobs, optionally pre-warm executables for a
+    design list, print ONE ``{"ready": true, ...}`` JSON line, then serve
+    until SIGTERM/SIGINT (graceful drain: queued requests are answered).
+
+``python -m raft_tpu.serve smoke``
+    The cross-process proof (``make serve-smoke``); see
+    :mod:`raft_tpu.serve.smoke`.
+"""
+from __future__ import annotations
+
+import argparse
+import json
+import signal
+import sys
+import threading
+import time
+
+
+def _daemon(argv) -> int:
+    t0 = time.perf_counter()
+    p = argparse.ArgumentParser(prog="raft_tpu.serve")
+    p.add_argument("--socket", default=None,
+                   help="AF_UNIX socket path (default: RAFT_TPU_SERVE_SOCKET"
+                        " or the per-uid tmp path)")
+    p.add_argument("--nw", type=int, default=100, help="frequency bins")
+    p.add_argument("--n-iter", type=int, default=25,
+                   help="fixed-point iterations per solve")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="override RAFT_TPU_SERVE_BATCH_DEADLINE_MS")
+    p.add_argument("--batch-max", type=int, default=None,
+                   help="override RAFT_TPU_SERVE_BATCH_MAX")
+    p.add_argument("--warm", default=None,
+                   help="comma-separated designs to pre-arm (e.g. "
+                        "'oc3,oc4,volturnus'): their buckets' executables "
+                        "are resolved before the ready line prints")
+    p.add_argument("--no-escalate", action="store_true",
+                   help="quarantine bad lanes without ladder salvage")
+    args = p.parse_args(argv)
+
+    from raft_tpu import cache
+    from raft_tpu.serve.config import ServeConfig
+    from raft_tpu.serve.server import SolverServer
+
+    cache.enable()           # warm-start layers; RAFT_TPU_CACHE_DIR governs
+
+    overrides: dict = {"nw": args.nw, "n_iter": args.n_iter,
+                       "escalate": not args.no_escalate}
+    if args.deadline_ms is not None:
+        overrides["batch_deadline_s"] = max(0.0, args.deadline_ms) / 1e3
+    if args.batch_max is not None:
+        overrides["batch_max"] = args.batch_max
+    cfg = ServeConfig.from_env(**overrides)
+    server = SolverServer(cfg, socket_path=args.socket)
+
+    def _term(_sig, _frm):
+        # stop() blocks on the solver drain — never inside a signal frame
+        threading.Thread(target=server.stop, name="serve-sigterm",
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    server.start()
+    warm = {}
+    if args.warm:
+        warm = server.warmup([s for s in args.warm.split(",") if s.strip()])
+    print(json.dumps({
+        "ready": True,
+        "socket": server.socket_path,
+        "ready_s": round(time.perf_counter() - t0, 3),
+        "warm": warm,
+        "batch_max": cfg.batch_max,
+        "batch_deadline_ms": round(cfg.batch_deadline_s * 1e3, 3),
+        "compiles_at_ready": cache.compile_count("sweep_designs"),
+        "cache_enabled": cache.is_enabled(),
+    }), flush=True)
+    server.wait()
+    print(json.dumps({"exit": True, "stats": server.core.stats(),
+                      "queue": server.batcher.counters()}), flush=True)
+    return 0
+
+
+def main(argv=None) -> int:
+    argv = sys.argv[1:] if argv is None else argv
+    if argv and argv[0] == "smoke":
+        from raft_tpu.serve import smoke
+
+        return smoke.main(argv[1:])
+    if argv and argv[0] == "daemon":
+        argv = argv[1:]
+    return _daemon(argv)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,130 @@
+"""Async client of the resident solver service.
+
+A :class:`SolveClient` owns one socket connection, a background reader
+thread, and a futures table keyed by request id: ``submit`` returns a
+:class:`concurrent.futures.Future` immediately (the open-loop load
+generator submits at its schedule regardless of completions), ``call``
+is the synchronous convenience wrapper.  Responses arrive in whatever
+order the server's batches close — the reader resolves each future by
+the ``id`` echoed in the response frame.
+"""
+from __future__ import annotations
+
+import itertools
+import socket
+import threading
+import time
+from concurrent.futures import Future
+
+from raft_tpu.serve import protocol
+
+
+class ServerGone(ConnectionError):
+    """The server closed the connection with requests still pending."""
+
+
+class SolveClient:
+    def __init__(self, socket_path: str, connect_timeout: float = 10.0,
+                 retry_interval: float = 0.05):
+        """Connect, retrying until ``connect_timeout`` — the standard way
+        to wait for a freshly-spawned daemon to bind its socket."""
+        self.socket_path = socket_path
+        deadline = time.monotonic() + connect_timeout
+        last: Exception | None = None
+        while True:
+            self._sock = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            try:
+                self._sock.connect(socket_path)
+                break
+            except OSError as e:
+                self._sock.close()
+                last = e
+                if time.monotonic() >= deadline:
+                    raise ConnectionError(
+                        f"could not reach solver daemon at {socket_path!r} "
+                        f"within {connect_timeout}s: {e}") from last
+                time.sleep(retry_interval)
+        self._wlock = threading.Lock()
+        self._flock = threading.Lock()
+        self._futures: dict = {}
+        self._ids = itertools.count()
+        self._closed = False
+        self._reader = threading.Thread(target=self._read_loop,
+                                        name="serve-client-reader",
+                                        daemon=True)
+        self._reader.start()
+
+    # ------------------------------------------------------------ plumbing
+    def _read_loop(self) -> None:
+        err: Exception = ServerGone("connection closed by server")
+        try:
+            while True:
+                obj = protocol.recv_msg(self._sock)
+                rid = obj.get("id") if isinstance(obj, dict) else None
+                with self._flock:
+                    fut = self._futures.pop(rid, None)
+                if fut is not None:
+                    fut.set_result(obj)
+                # responses for unknown ids (e.g. a server-side error
+                # frame with id=None) are dropped — nothing waits on them
+        except (protocol.PeerClosed, protocol.ProtocolError, OSError) as e:
+            if not self._closed:
+                err = e if isinstance(e, Exception) else err
+        with self._flock:
+            pending = list(self._futures.values())
+            self._futures.clear()
+        for fut in pending:
+            fut.set_exception(ServerGone(str(err)))
+
+    def submit(self, obj: dict) -> Future:
+        """Send one request frame; returns the Future of its response.
+        Assigns a fresh ``id`` unless the caller set one."""
+        if "id" not in obj or obj["id"] is None:
+            obj = {**obj, "id": f"c{next(self._ids)}"}
+        fut: Future = Future()
+        with self._flock:
+            if self._closed:
+                raise ConnectionError("client is closed")
+            self._futures[obj["id"]] = fut
+        try:
+            with self._wlock:
+                protocol.send_msg(self._sock, obj)
+        except OSError as e:
+            with self._flock:
+                self._futures.pop(obj["id"], None)
+            raise ConnectionError(f"send failed: {e}") from e
+        return fut
+
+    def call(self, obj: dict, timeout: float = 120.0) -> dict:
+        """Submit and wait; raises on transport failure, returns the
+        response dict (check ``ok`` for application-level errors)."""
+        return self.submit(obj).result(timeout)
+
+    # ------------------------------------------------------- conveniences
+    def ping(self, timeout: float = 10.0) -> dict:
+        return self.call({"op": "ping"}, timeout)
+
+    def stats(self, timeout: float = 30.0) -> dict:
+        return self.call({"op": "stats"}, timeout)
+
+    def solve(self, design, Hs: float, Tp: float,
+              timeout: float = 120.0) -> dict:
+        return self.call({"op": "solve", "design": design,
+                          "Hs": Hs, "Tp": Tp}, timeout)
+
+    def shutdown(self, timeout: float = 30.0) -> dict:
+        return self.call({"op": "shutdown"}, timeout)
+
+    def close(self) -> None:
+        self._closed = True
+        try:
+            self._sock.shutdown(socket.SHUT_RDWR)
+        except OSError:
+            pass
+        self._sock.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()

@@ -1,0 +1,91 @@
+"""Serve-loop configuration, snapshotted at arm time.
+
+The GL303 contract (docs/lint.rst): a resident process must read its env
+knobs ONCE, when the daemon arms, and carry the snapshot — a mid-process
+``os.environ`` change would silently diverge the loop's behavior from
+whatever was folded into the AOT keys and logged at startup.  So the
+concurrent request path (batcher, solver loop, connection readers) only
+ever sees this frozen dataclass; :func:`ServeConfig.from_env` is called
+from ``python -m raft_tpu.serve`` / the smoke harness / the bench — all
+arm-time, none reachable from a registered concurrent entry point.
+
+Knobs (registered in :mod:`raft_tpu.lint.knobs`):
+
+* ``RAFT_TPU_SERVE_BATCH_DEADLINE_MS`` — how long an open micro-batch
+  may wait for company before it closes anyway (default 25 ms).  Pure
+  scheduling: because every dispatch is padded to the fixed lane
+  capacity, the deadline changes LATENCY, never results.
+* ``RAFT_TPU_SERVE_BATCH_MAX`` — the fixed per-bucket lane capacity
+  (default 8).  Every dispatch is padded to exactly this many lanes, so
+  each bucket compiles ONE executable regardless of occupancy; the
+  capacity is also folded into the serve executable keys explicitly
+  (:func:`raft_tpu.serve.solver.batch_salt`).
+* ``RAFT_TPU_SERVE_SOCKET`` — default daemon socket path.
+"""
+from __future__ import annotations
+
+import dataclasses
+import os
+import tempfile
+
+DEADLINE_ENV = "RAFT_TPU_SERVE_BATCH_DEADLINE_MS"
+BATCH_MAX_ENV = "RAFT_TPU_SERVE_BATCH_MAX"
+SOCKET_ENV = "RAFT_TPU_SERVE_SOCKET"
+
+DEFAULT_DEADLINE_MS = 25.0
+DEFAULT_BATCH_MAX = 8
+
+
+def default_socket_path() -> str:
+    """Default AF_UNIX socket path (per-uid tmp namespace)."""
+    return os.path.join(tempfile.gettempdir(),
+                        f"raft_tpu_serve_{os.getuid()}.sock")
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Frozen arm-time snapshot of everything the serve loop consults."""
+
+    batch_deadline_s: float = DEFAULT_DEADLINE_MS / 1e3
+    batch_max: int = DEFAULT_BATCH_MAX
+    socket_path: str = ""
+    # solve parameters shared by every lane (the frequency grid is a
+    # server-level contract: lanes of one bucket must stack one padded
+    # grid, so per-request grids would fragment the buckets)
+    nw: int = 100
+    w_min: float = 0.05
+    w_max: float = 2.95
+    n_iter: int = 25
+    escalate: bool = True
+    # optional dispatch-ahead chunking of each padded batch through
+    # parallel/pipeline.py (None = one dispatch per batch — right for
+    # interactive capacities; set for very large batch_max on small HBM)
+    chunk: int | None = None
+
+    @classmethod
+    def from_env(cls, **overrides) -> "ServeConfig":
+        """Snapshot the ``RAFT_TPU_SERVE_*`` knobs (called at ARM time
+        only — never from the request path).  ``overrides`` win over the
+        environment (CLI flags, test fixtures)."""
+        vals: dict = {}
+        raw = os.environ.get(DEADLINE_ENV, "").strip()
+        if raw:
+            try:
+                vals["batch_deadline_s"] = max(0.0, float(raw)) / 1e3
+            except ValueError:
+                raise ValueError(
+                    f"{DEADLINE_ENV}={raw!r} is not a number (milliseconds)")
+        raw = os.environ.get(BATCH_MAX_ENV, "").strip()
+        if raw:
+            try:
+                vals["batch_max"] = int(raw)
+            except ValueError:
+                raise ValueError(f"{BATCH_MAX_ENV}={raw!r} is not an integer")
+        vals["socket_path"] = (os.environ.get(SOCKET_ENV, "").strip()
+                               or default_socket_path())
+        vals.update(overrides)
+        cfg = cls(**vals)
+        if cfg.batch_max < 1:
+            raise ValueError(f"{BATCH_MAX_ENV} must be >= 1, got "
+                             f"{cfg.batch_max}")
+        return cfg

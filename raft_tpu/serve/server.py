@@ -1,0 +1,370 @@
+"""The resident solver daemon: socket front, batcher middle, warm core.
+
+Thread layout (all state lock-owned or single-writer by construction):
+
+* one ACCEPT thread — listens on the AF_UNIX socket, spawns a reader per
+  connection;
+* N CONNECTION READER threads — frame/parse/validate requests, stage
+  each lane (memoized, see :meth:`~raft_tpu.serve.solver.SolverCore.
+  stage_lane` — this is where a lane learns its bucket signature), and
+  submit lanes to the :class:`~raft_tpu.serve.batcher.MicroBatcher`;
+  control ops (``ping``/``stats``/``refresh``/``shutdown``) answer
+  inline;
+* ONE SOLVER LOOP thread — drains the batcher (deadline-or-capacity
+  closes), solves each batch through :func:`~raft_tpu.serve.solver.
+  solve_batch`, slices rows back to their owning requests, and sends
+  each response the moment its last lane lands.
+
+Graceful shutdown (``shutdown`` op or SIGTERM via ``python -m
+raft_tpu.serve``): stop intake, flush every pending bucket (the batcher
+drains closed), answer everything in flight, then exit — a client that
+got its request in gets its response out.
+
+Observability (armed by ``RAFT_TPU_OBS`` like every other subsystem):
+per-bucket ``serve.queue_wait_s[SxNxW]`` latency histograms (submit ->
+batch close), ``serve.batch_occupancy[SxNxW]`` gauges plus exact
+``serve.lanes``/``serve.batches`` counters, and the solver's own
+per-bucket dispatch histograms underneath.
+"""
+from __future__ import annotations
+
+import os
+import socket
+import threading
+import time
+
+from raft_tpu.serve import protocol
+from raft_tpu.serve.batcher import Lane, MicroBatcher
+from raft_tpu.serve.config import ServeConfig
+from raft_tpu.serve.solver import SolverCore, solve_batch
+
+#: daemon request-path functions under the GL3xx concurrency contracts
+__graftlint_concurrent__ = ("_handle_conn", "_solve_loop", "_deliver",
+                            "_submit_lanes", "_control", "_bucket_label")
+
+
+def _bucket_label(sig) -> str:
+    return f"{sig.segments}x{sig.nodes}x{sig.nw}"
+
+
+class _Conn:
+    """One client connection: the socket plus its write lock (responses
+    are sent from the solver loop AND control answers from the reader —
+    frames must not interleave)."""
+
+    def __init__(self, sock):
+        self.sock = sock
+        self.wlock = threading.Lock()
+
+    def send(self, obj) -> bool:
+        try:
+            with self.wlock:
+                protocol.send_msg(self.sock, obj)
+            return True
+        except (OSError, ValueError):
+            return False          # client went away; its results drop
+
+
+class _PendingRequest:
+    """Fan-in state of one multi-lane request.  Rows are filled by the
+    single solver-loop thread only; ``done`` counts under the server's
+    requests lock (an error path may also finish a request)."""
+
+    def __init__(self, conn: _Conn, req_id, n_lanes: int, clock):
+        self.conn = conn
+        self.id = req_id
+        self.rows = [None] * n_lanes
+        self.waits = [0.0] * n_lanes
+        self.remaining = n_lanes
+        self.error = None        # first batch failure poisons the request
+        self.t0 = clock()
+
+
+class SolverServer:
+    """See module docstring.  ``config`` is the arm-time snapshot
+    (:meth:`ServeConfig.from_env` — never re-read on the request path);
+    ``clock`` is injectable for the deterministic tests."""
+
+    def __init__(self, config: ServeConfig | None = None,
+                 socket_path: str | None = None, clock=time.monotonic):
+        self.config = config or ServeConfig.from_env()
+        self.socket_path = socket_path or self.config.socket_path
+        self.clock = clock
+        self.core = SolverCore(self.config)
+        self.batcher = MicroBatcher(self.config.batch_deadline_s,
+                                    self.config.batch_max, clock=clock)
+        self._lock = threading.Lock()    # guards _PendingRequest fan-in
+        self._threads: list = []
+        self._listener = None
+        self._stopping = threading.Event()
+        self._solver_done = threading.Event()
+        self.t_armed = time.monotonic()
+
+    # ----------------------------------------------------------- warmup
+    def warmup(self, designs, Hs: float = 8.0, Tp: float = 12.0) -> dict:
+        """Arm the service for a design list BEFORE accepting traffic:
+        stage one lane per design and solve one padded batch per distinct
+        bucket, so every executable is resolved (AOT disk load on a warm
+        root, compile on a cold one) ahead of the first client.  Returns
+        per-bucket arming info; ``ready-to-serve`` time in the smoke is
+        measured through this."""
+        by_sig: dict = {}
+        for spec in designs:
+            design, label = protocol.resolve_design(spec)
+            sig, staged = self.core.stage_lane(design, Hs, Tp)
+            by_sig.setdefault(sig, Lane(request_id=None, seq=0, label=label,
+                                        staged=staged))
+        info = {}
+        for sig, lane in by_sig.items():
+            _rows, binfo = solve_batch(self.core, sig, [lane])
+            info[_bucket_label(sig)] = {"lanes": binfo["lanes"],
+                                        "capacity": binfo["capacity"]}
+        return info
+
+    # ---------------------------------------------------------- control
+    def start(self) -> None:
+        """Bind the socket and start the accept + solver threads."""
+        path = self.socket_path
+        try:
+            os.unlink(path)                   # stale socket from a kill
+        except FileNotFoundError:
+            pass
+        self._listener = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+        self._listener.bind(path)
+        self._listener.listen(64)
+        t_solve = threading.Thread(target=self._solve_loop,
+                                   name="serve-solver", daemon=True)
+        t_accept = threading.Thread(target=self._accept_loop,
+                                    name="serve-accept", daemon=True)
+        self._threads += [t_solve, t_accept]
+        t_solve.start()
+        t_accept.start()
+
+    def stop(self, timeout: float = 30.0) -> None:
+        """Graceful drain: stop intake, flush pending batches, answer
+        in-flight requests, close the listener."""
+        if self._stopping.is_set():
+            return
+        self._stopping.set()
+        if self._listener is not None:
+            try:
+                self._listener.close()
+            except OSError:
+                pass
+        self.batcher.close()
+        self._solver_done.wait(timeout)
+        try:
+            os.unlink(self.socket_path)
+        except OSError:
+            pass
+
+    def wait(self, timeout: float | None = None) -> bool:
+        """Block until the solver loop has drained and exited."""
+        return self._solver_done.wait(timeout)
+
+    def serve_forever(self) -> None:
+        """``start()`` then block until :meth:`stop` completes (the
+        daemon entry point; ``python -m raft_tpu.serve`` wires SIGTERM to
+        ``stop``)."""
+        self.start()
+        self._solver_done.wait()
+
+    # ------------------------------------------------------ accept side
+    def _accept_loop(self) -> None:
+        while not self._stopping.is_set():
+            try:
+                sock, _ = self._listener.accept()
+            except OSError:
+                break                          # listener closed by stop()
+            t = threading.Thread(target=self._handle_conn,
+                                 args=(_Conn(sock),),
+                                 name="serve-conn", daemon=True)
+            # bounded bookkeeping in a long-lived daemon: drop handles of
+            # connections that already hung up
+            self._threads = [x for x in self._threads if x.is_alive()]
+            self._threads.append(t)
+            t.start()
+
+    def _handle_conn(self, conn: _Conn) -> None:
+        try:
+            while True:
+                try:
+                    obj = protocol.recv_msg(conn.sock)
+                except protocol.PeerClosed:
+                    return
+                except protocol.ProtocolError as e:
+                    if not conn.send(protocol.error_response(None, e)):
+                        return
+                    continue
+                try:
+                    req = protocol.parse_request(obj)
+                except protocol.ProtocolError as e:
+                    conn.send(protocol.error_response(
+                        obj.get("id") if isinstance(obj, dict) else None, e))
+                    continue
+                if req["op"] in ("ping", "stats", "refresh", "shutdown"):
+                    stop = self._control(conn, req, obj)
+                    if stop:
+                        return
+                    continue
+                try:
+                    self._submit_lanes(conn, req)
+                except Exception as e:         # staging/validation failure
+                    conn.send(protocol.error_response(req["id"], e))
+        finally:
+            try:
+                conn.sock.close()
+            except OSError:
+                pass
+
+    def _control(self, conn: _Conn, req: dict, raw: dict) -> bool:
+        """Answer a control op inline; returns True when the server
+        should stop (shutdown)."""
+        op = req["op"]
+        if op == "ping":
+            conn.send({"id": req["id"], "ok": True, "op": "ping",
+                       "uptime_s": round(time.monotonic() - self.t_armed, 3)})
+            return False
+        if op == "stats":
+            conn.send({"id": req["id"], "ok": True, "op": "stats",
+                       "solver": self.core.stats(),
+                       "queue": self.batcher.counters(),
+                       "queue_depth": self.batcher.depth()})
+            return False
+        if op == "refresh":
+            # operator-carried knob values (NOT an env re-read: the env
+            # snapshot stays arm-time per GL303; explicit values in the
+            # request are a configuration action, like restarting).
+            # Validate BEFORE touching anything — a malformed value must
+            # answer with an error, never kill the reader thread.
+            try:
+                new_deadline = raw.get("deadline_ms")
+                new_max = raw.get("batch_max")
+                if new_deadline is not None:
+                    new_deadline = max(0.0, float(new_deadline)) / 1e3
+                if new_max is not None:
+                    new_max = int(new_max)
+                    if new_max < 1:
+                        raise ValueError("batch_max must be >= 1")
+            except (TypeError, ValueError) as e:
+                conn.send(protocol.error_response(req["id"], e))
+                return False
+            info = self.core.refresh()
+            if new_deadline is not None:
+                self.batcher.set_deadline(new_deadline)
+            if new_max is not None:
+                import dataclasses
+
+                # config first, then the batcher (both under their own
+                # locks): a batch popped during the transition may carry
+                # the OLD capacity's lane count — solve_batch pads to
+                # max(capacity, lanes), so either interleaving solves.
+                # The new capacity is a new abstract batch signature, so
+                # the next dispatch per bucket re-resolves its executable
+                # (AOT disk or compile); nothing stale can be served.
+                self.core.config = dataclasses.replace(
+                    self.core.config, batch_max=new_max)
+                self.batcher.set_batch_max(new_max)
+            conn.send({"id": req["id"], "ok": True, "op": "refresh",
+                       **info,
+                       "batch_deadline_ms":
+                           round(self.batcher.deadline_s * 1e3, 3),
+                       "batch_max": self.batcher.batch_max})
+            return False
+        # shutdown: acknowledge, then drain gracefully.  The reader holds
+        # THIS connection open until the solver loop finishes — the
+        # requester (or anything sharing its connection) may still be
+        # owed responses for queued lanes, and returning now would close
+        # the socket underneath them.
+        conn.send({"id": req["id"], "ok": True, "op": "shutdown"})
+        threading.Thread(target=self.stop, name="serve-stop",
+                         daemon=True).start()
+        self._solver_done.wait(60.0)
+        return True
+
+    def _submit_lanes(self, conn: _Conn, req: dict) -> None:
+        lanes = []
+        for seq, (design, label, Hs, Tp) in enumerate(req["lanes"]):
+            sig, staged = self.core.stage_lane(design, Hs, Tp)
+            lanes.append((sig, Lane(request_id=None, seq=seq, label=label,
+                                    staged=staged)))
+        pend = _PendingRequest(conn, req["id"], len(lanes), self.clock)
+        for _sig, lane in lanes:
+            lane.request_id = pend
+        try:
+            for sig, lane in lanes:
+                self.batcher.submit(sig, lane)
+        except RuntimeError as e:              # raced shutdown
+            conn.send(protocol.error_response(req["id"], e))
+
+    # ------------------------------------------------------ solver side
+    def _solve_loop(self) -> None:
+        from raft_tpu import obs as _obs
+
+        try:
+            while True:
+                item = self.batcher.next_batch()
+                if item is None:
+                    return
+                sig, lanes = item
+                label = _bucket_label(sig)
+                now = self.clock()
+                for ln in lanes:
+                    _obs.metrics.histogram(
+                        f"serve.queue_wait_s[{label}]").observe(
+                            max(0.0, now - ln.t_submit))
+                with _obs.trace.span("serve/batch",
+                                     attrs={"sig": label,
+                                            "lanes": len(lanes)}):
+                    try:
+                        rows, info = solve_batch(self.core, sig, lanes)
+                    except Exception as e:     # a poisoned batch must not
+                        self._fail_batch(lanes, e)   # kill the daemon
+                        continue
+                _obs.metrics.gauge(
+                    f"serve.batch_occupancy[{label}]").set(info["occupancy"])
+                _obs.metrics.counter("serve.batches").inc()
+                _obs.metrics.counter("serve.lanes").inc(len(lanes))
+                self._deliver(lanes, rows, now)
+        finally:
+            self._solver_done.set()
+
+    def _fail_batch(self, lanes, exc) -> None:
+        # a failed batch POISONS every request it carried lanes for: the
+        # request answers with the error once its last lane lands, even
+        # when its other lanes (in other batches) solved fine — a
+        # multi-bucket sweep must never get ok:true with null rows
+        finished = []
+        with self._lock:
+            for ln in lanes:
+                pend = ln.request_id
+                if pend.error is None:
+                    pend.error = exc
+                pend.remaining -= 1
+                if pend.remaining <= 0:
+                    finished.append(pend)
+        for pend in finished:
+            pend.conn.send(protocol.error_response(pend.id, pend.error))
+
+    def _deliver(self, lanes, rows, t_close) -> None:
+        finished = []
+        with self._lock:
+            for ln, row in zip(lanes, rows):
+                pend = ln.request_id
+                pend.rows[ln.seq] = row
+                pend.waits[ln.seq] = round(max(0.0, t_close - ln.t_submit), 6)
+                pend.remaining -= 1
+                if pend.remaining <= 0:
+                    finished.append(pend)
+        for pend in finished:
+            if pend.error is not None:     # another batch of this request
+                pend.conn.send(            # failed earlier
+                    protocol.error_response(pend.id, pend.error))
+                continue
+            pend.conn.send({
+                "id": pend.id,
+                "ok": True,
+                "results": pend.rows,
+                "t_queue_s": pend.waits,
+                "t_total_s": round(self.clock() - pend.t0, 6),
+            })

@@ -1,0 +1,240 @@
+"""Serve-smoke: cross-process proof of the resident solver service.
+
+``python -m raft_tpu.serve smoke`` (``make serve-smoke``, CI fast job,
+< 60 s CPU) spawns the REAL daemon in a child process on a fresh
+warm-start cache root and proves, over the real socket:
+
+* a mixed 3-design request stream (OC3 spar + OC4 semi + VolturnUS-S,
+  varied sea states) is answered with exactly ``n_buckets`` compiles —
+  the serving loop inherits the O(buckets) collapse;
+* every response parity-matches a solo solve of the same request through
+  the same padded path in THIS process (bit-identical: lanes are
+  value-independent and the executables come off the shared AOT disk
+  cache);
+* SIGTERM is graceful (rc 0, socket unlinked), and a WARM RESTART on the
+  same cache root reaches ready-to-serve with ZERO compiles (every
+  bucket an AOT disk hit), in strictly less time than the cold start,
+  and serves the same stream bit-identically.
+
+Prints one JSON line; rc 0 iff all checks hold.
+"""
+from __future__ import annotations
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import tempfile
+import time
+
+#: the mixed stream: (design alias, Hs, Tp) — 3 designs x 3 sea states,
+#: landing in 2 buckets under the stock ladder
+STREAM = [(d, 6.0 + 0.5 * (i % 3), 10.0 + 0.5 * (i % 2))
+          for i, d in enumerate(["oc3", "oc4", "volturnus"] * 3)]
+
+NW = 16
+N_ITER = 12
+BATCH_MAX = 4
+DEADLINE_MS = 40.0
+
+
+def _child_env(cache_dir: str) -> dict:
+    env = dict(os.environ)
+    env["RAFT_TPU_CACHE_DIR"] = cache_dir
+    env["JAX_PLATFORMS"] = "cpu"
+    # deterministic whatever environment launches it (hetero-smoke
+    # precedent): a virtual-device mesh or ladder override would change
+    # the AOT keys between parent and child
+    env.pop("XLA_FLAGS", None)
+    env.pop("RAFT_TPU_BUCKETS", None)
+    env.pop("RAFT_TPU_SERVE_BATCH_DEADLINE_MS", None)
+    env.pop("RAFT_TPU_SERVE_BATCH_MAX", None)
+    return env
+
+
+def _read_ready_line(proc, timeout_s: float) -> str:
+    """First non-blank stdout line of the daemon child, read in a helper
+    thread so the deadline is REAL (a bare ``readline()`` blocks forever
+    on a hung child and the deadline check never re-runs)."""
+    import threading
+
+    box: list = []
+
+    def reader():
+        while True:
+            line = proc.stdout.readline()
+            if not line:            # EOF: child died without a line
+                return
+            if line.strip():
+                box.append(line)
+                return
+
+    t = threading.Thread(target=reader, daemon=True)
+    t.start()
+    t.join(timeout_s)
+    if box:
+        return box[0]
+    if t.is_alive():                # hung child: kill, then fail loud
+        proc.kill()
+        proc.wait(10.0)
+        raise RuntimeError(f"daemon printed no ready line in {timeout_s}s")
+    raise RuntimeError(
+        f"daemon died before ready (rc={proc.wait(10.0)})")
+
+
+def _spawn_daemon(cache_dir: str, sock: str, stderr_path: str):
+    # a DAEMON child is unbounded by design: its lifetime is managed
+    # explicitly (threaded ready-line deadline in _read_ready_line,
+    # SIGTERM + bounded wait in _stop_daemon, kill on timeout) rather
+    # than by a subprocess timeout.  stderr goes to a FILE, not a pipe —
+    # a chatty child (XLA compile logging) must never block on a pipe
+    # buffer nobody drains mid-run; the tail is read back on failure.
+    stderr_f = open(stderr_path, "w")
+    proc = subprocess.Popen(  # graftlint: disable=GL203
+        [sys.executable, "-m", "raft_tpu.serve", "daemon",
+         "--socket", sock, "--nw", str(NW), "--n-iter", str(N_ITER),
+         "--deadline-ms", str(DEADLINE_MS), "--batch-max", str(BATCH_MAX),
+         "--warm", "oc3,oc4,volturnus"],
+        stdout=subprocess.PIPE, stderr=stderr_f, text=True,
+        env=_child_env(cache_dir),
+        cwd=os.path.dirname(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))),
+    )
+    stderr_f.close()                 # the child holds its own handle
+    t0 = time.perf_counter()
+    try:
+        line = _read_ready_line(proc, 300.0)
+    except RuntimeError as e:
+        try:
+            with open(stderr_path) as f:
+                tail = f.read()[-2000:]
+        except OSError:
+            tail = "<stderr unavailable>"
+        raise RuntimeError(f"{e}\n--- daemon stderr tail ---\n{tail}")
+    ready = json.loads(line)
+    if not ready.get("ready"):
+        raise RuntimeError(f"unexpected daemon line: {line!r}")
+    ready["spawn_to_ready_s"] = round(time.perf_counter() - t0, 3)
+    return proc, ready
+
+
+def _drive_stream(sock: str):
+    """Submit the whole mixed stream open-loop, collect responses + final
+    server stats; returns (per-request std_dev rows, stats)."""
+    from raft_tpu.serve.client import SolveClient
+
+    with SolveClient(sock, connect_timeout=30.0) as cl:
+        futs = [cl.submit({"op": "solve", "design": d, "Hs": Hs, "Tp": Tp})
+                for d, Hs, Tp in STREAM]
+        rows = []
+        for f in futs:
+            r = f.result(120.0)
+            if not r.get("ok"):
+                raise RuntimeError(f"request failed: {r.get('error')}")
+            rows.append(r["results"][0]["std_dev"])
+        stats = cl.stats()["solver"]
+    return rows, stats
+
+
+def _stop_daemon(proc) -> int:
+    proc.send_signal(signal.SIGTERM)
+    try:
+        proc.wait(60.0)
+    except subprocess.TimeoutExpired:
+        proc.kill()
+        proc.wait(10.0)
+        return -9
+    return proc.returncode
+
+
+def _solo_reference(cache_dir: str):
+    """Solo rows computed IN THIS PROCESS through the same padded batch
+    path, executables off the shared AOT disk cache — the parity (and
+    cross-process determinism) reference."""
+    os.environ["RAFT_TPU_CACHE_DIR"] = cache_dir
+    os.environ["JAX_PLATFORMS"] = "cpu"
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    from raft_tpu import cache
+    from raft_tpu.serve import protocol
+    from raft_tpu.serve.config import ServeConfig
+    from raft_tpu.serve.solver import SolverCore, solve_solo
+
+    cache.enable(cache_dir)
+    cfg = ServeConfig(batch_deadline_s=DEADLINE_MS / 1e3,
+                      batch_max=BATCH_MAX, nw=NW, n_iter=N_ITER)
+    core = SolverCore(cfg)
+    rows = []
+    for d, Hs, Tp in STREAM:
+        design, _label = protocol.resolve_design(d)
+        rows.append(solve_solo(core, design, Hs, Tp)["std_dev"])
+    return rows, cache.compile_count("sweep_designs")
+
+
+def main(argv=None) -> int:
+    t_all = time.perf_counter()
+    keep = argv and "--keep" in argv
+    tmp = tempfile.mkdtemp(prefix="raft_tpu_serve_smoke_")
+    cache_dir = os.path.join(tmp, "cache")
+    sock1 = os.path.join(tmp, "serve1.sock")
+    sock2 = os.path.join(tmp, "serve2.sock")
+    try:
+        # ---- cold daemon: compile, serve, graceful SIGTERM ----
+        proc1, ready1 = _spawn_daemon(cache_dir, sock1,
+                                      os.path.join(tmp, "daemon1.err"))
+        rows1, stats1 = _drive_stream(sock1)
+        rc1 = _stop_daemon(proc1)
+        sock1_gone = not os.path.exists(sock1)
+
+        # ---- warm restart: zero compiles off the AOT disk cache ----
+        proc2, ready2 = _spawn_daemon(cache_dir, sock2,
+                                      os.path.join(tmp, "daemon2.err"))
+        rows2, stats2 = _drive_stream(sock2)
+        rc2 = _stop_daemon(proc2)
+
+        # ---- in-process solo reference off the same cache root ----
+        solo_rows, solo_compiles = _solo_reference(cache_dir)
+
+        n_buckets = len(stats1["buckets"])
+        checks = {
+            "cold_compiles_eq_buckets": stats1["compiles"] == n_buckets,
+            "fewer_compiles_than_designs": stats1["compiles"] < 3,
+            "responses_match_solo_bitwise": rows1 == solo_rows,
+            "sigterm_graceful_rc0": rc1 == 0,
+            "socket_unlinked": sock1_gone,
+            "warm_zero_compiles": stats2["compiles"] == 0,
+            "warm_restart_bitwise_identical": rows2 == rows1,
+            "warm_ready_faster_than_cold":
+                ready2["ready_s"] < ready1["ready_s"],
+            "warm_rc0": rc2 == 0,
+            "solo_zero_compiles": solo_compiles == 0,
+        }
+        ok = all(checks.values())
+        print(json.dumps({
+            "ok": ok,
+            **checks,
+            "n_requests": len(STREAM),
+            "n_buckets": n_buckets,
+            "cold_compiles": stats1["compiles"],
+            "warm_compiles": stats2["compiles"],
+            "cold_ready_s": ready1["ready_s"],
+            "warm_ready_s": ready2["ready_s"],
+            "warm_restart_speedup": (
+                round(ready1["ready_s"] / ready2["ready_s"], 2)
+                if ready2["ready_s"] > 0 else None),
+            "bucket_stats_cold": stats1["buckets"],
+            "wall_s": round(time.perf_counter() - t_all, 2),
+            **({"dir": tmp} if keep else {}),
+        }))
+        return 0 if ok else 1
+    finally:
+        if not keep:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":                                # pragma: no cover
+    sys.exit(main())

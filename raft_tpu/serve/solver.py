@@ -1,0 +1,264 @@
+"""Warm solving core of the resident service: staging memo + padded batch.
+
+Two jobs, both built to keep the request path free of cold work:
+
+* :meth:`SolverCore.stage_lane` — turn one ``(design, Hs, Tp)`` request
+  lane into its bucket-padded staged arrays via the ONE shared recipe
+  (:func:`raft_tpu.model._stage_design_one`, the same body every other
+  entry point stages through), memoized: a stream that re-asks for the
+  same design x sea state pays the YAML parse, member build, and mooring
+  linearization exactly once per daemon life.  Staging happens in the
+  CONNECTION READER thread at submit time (it also determines the lane's
+  bucket signature for routing), so the solver loop only ever stacks
+  warm arrays.
+* :func:`solve_batch` — pad a closed batch to the FIXED lane capacity
+  (``ServeConfig.batch_max``; unused lanes tile the real ones), stack
+  the staged lanes into a :class:`raft_tpu.model.DesignBatch`, and solve
+  it through :func:`raft_tpu.parallel.sweep.sweep_designs` with the
+  resilience contract on — a client whose lane goes NaN is quarantined
+  and ladder-salvaged without perturbing batch-mates — then slice the
+  per-lane rows back out in request order.
+
+Why the fixed capacity matters twice: (1) every occupancy of a bucket
+shares ONE abstract signature, so the whole serving run compiles (or
+AOT-loads) exactly ``n_buckets`` executables — the acceptance gate; and
+(2) a lane's result is bit-identical no matter which batch it rode in
+(vmapped lanes are value-independent; padding removes the remaining
+shape dependence), which is what makes deadline-vs-capacity closes a
+pure latency tradeoff.
+"""
+from __future__ import annotations
+
+import hashlib
+import json
+import threading
+from collections import OrderedDict
+
+#: serve-loop functions under the GL3xx concurrency contracts (the
+#: in-module analog of ``lint/registry.py``'s CONCURRENT_FUNCTIONS;
+#: ``solve_batch`` additionally rides the registry's concurrent=True
+#: ``serve_solve`` entry)
+__graftlint_concurrent__ = ("solve_batch", "stage_lane", "design_key",
+                            "solve_solo")
+
+#: staged-lane memo bound: ~hundreds of distinct (design, sea-state)
+#: pairs resident before LRU eviction; a lane is a few MB at stock sizes
+_MEMO_MAX = 256
+
+
+def design_key(spec) -> str:
+    """Stable identity of a design argument: the path string for YAML
+    files, a content hash for inline dicts (two requests carrying equal
+    dicts share one staging)."""
+    if isinstance(spec, str):
+        return spec
+    return "sha:" + hashlib.sha256(
+        json.dumps(spec, sort_keys=True, default=repr).encode()
+    ).hexdigest()[:24]
+
+
+class SolverCore:
+    """Resident staging memo + batch solver (see module docstring).
+
+    Thread contract: ``stage_lane`` runs in N connection readers
+    concurrently (single-flight per memo key under ``_lock`` — two
+    clients asking for the same cold design stage it once);
+    ``solve_batch`` runs in the single solver loop.  ``refresh`` may run
+    from a control request between batches.
+    """
+
+    def __init__(self, config):
+        self.config = config
+        self._lock = threading.Lock()
+        self._memo: OrderedDict = OrderedDict()   # key -> (sig, staged)
+        self._inflight: dict = {}                 # key -> threading.Event
+        self._stats_lock = threading.Lock()
+        self._bucket_stats: dict = {}   # sig -> [batches, real_lanes]
+
+    # ---------------------------------------------------------- staging
+    def stage_lane(self, design, Hs: float, Tp: float):
+        """Memoized lane staging; returns ``(sig, staged)`` where
+        ``staged = (members, rna, env, wave, C_moor)`` is bucket-padded
+        and ``sig`` is the lane's routing signature (any self-healing
+        promotion already applied)."""
+        key = (design_key(design), float(Hs), float(Tp))
+        while True:
+            with self._lock:
+                hit = self._memo.get(key)
+                if hit is not None:
+                    self._memo.move_to_end(key)
+                    return hit
+                ev = self._inflight.get(key)
+                if ev is None:
+                    ev = self._inflight[key] = threading.Event()
+                    break
+            ev.wait()
+        try:
+            from raft_tpu.model import _stage_design_one, load_design
+
+            cfg = self.config
+            d = load_design(design)
+            members, sig, rna, env, wave, C_moor = _stage_design_one(
+                d, cfg.nw, float(Hs), float(Tp), cfg.w_min, cfg.w_max,
+                with_mooring=True, bucket=True)
+            out = (sig, (members, rna, env, wave, C_moor))
+            with self._lock:
+                self._memo[key] = out
+                while len(self._memo) > _MEMO_MAX:
+                    self._memo.popitem(last=False)
+            return out
+        finally:
+            with self._lock:
+                self._inflight.pop(key, None)
+            ev.set()
+
+    # ------------------------------------------------------------ admin
+    def refresh(self) -> dict:
+        """Graceful executor/staging refresh (the ``refresh`` op): drop
+        the staged-lane memo and evict this loop's executables from the
+        in-process AOT memo so the NEXT batch re-resolves them — from the
+        AOT disk cache when the program is unchanged (cheap), or via a
+        fresh compile when a ladder/knob change re-keyed it.  Runs
+        between batches (the solver loop owns the call); in-flight
+        results are never invalidated.  Returns eviction counts."""
+        from raft_tpu import cache as _cache
+
+        with self._lock:
+            n_lanes = len(self._memo)
+            self._memo.clear()
+        n_exec = _cache.evict_memory("sweep_designs")
+        return {"staged_lanes_dropped": n_lanes,
+                "executables_evicted": n_exec}
+
+    def record_batch(self, sig, n_real: int) -> None:
+        with self._stats_lock:
+            st = self._bucket_stats.setdefault(sig, [0, 0])
+            st[0] += 1
+            st[1] += n_real
+
+    def reset_stats(self) -> None:
+        """Zero the per-bucket batch/occupancy accounting (measurement
+        window boundaries: the bench's warm pass vs measured pass)."""
+        with self._stats_lock:
+            self._bucket_stats.clear()
+
+    def stats(self) -> dict:
+        from raft_tpu import cache as _cache
+
+        cfg = self.config
+        with self._stats_lock:
+            per = {
+                str(tuple(sig)): {
+                    "batches": b,
+                    "lanes": r,
+                    "mean_occupancy": round(r / (b * cfg.batch_max), 4),
+                }
+                for sig, (b, r) in self._bucket_stats.items()
+            }
+        return {
+            "batch_max": cfg.batch_max,
+            "batch_deadline_ms": round(cfg.batch_deadline_s * 1e3, 3),
+            "nw": cfg.nw,
+            "n_iter": cfg.n_iter,
+            "buckets": per,
+            "compiles": _cache.compile_count("sweep_designs"),
+            "cache_enabled": _cache.is_enabled(),
+        }
+
+
+def _stack_batch(sig, staged_lanes, labels, nw: int):
+    """Stack per-lane staged tuples into a :class:`DesignBatch` (the
+    exact layout ``stage_designs`` builds, minus the per-batch parse —
+    the lanes were staged and memoized individually)."""
+    from raft_tpu.model import DesignBatch, _stack_trees
+    import jax.numpy as jnp
+
+    ms, rnas, envs, waves, cms = zip(*staged_lanes)
+    return DesignBatch(
+        sig=sig,
+        fnames=list(labels),
+        indices=list(range(len(labels))),
+        members=_stack_trees(ms),
+        rna=_stack_trees(rnas),
+        env=_stack_trees(envs),
+        wave=_stack_trees(waves),
+        C_moor=None if cms[0] is None else jnp.stack(cms),
+        nw=int(nw),
+    )
+
+
+def solve_batch(core: SolverCore, sig, lanes):
+    """Solve one closed micro-batch; returns ``(rows, info)``.
+
+    ``lanes``: the :class:`~raft_tpu.serve.batcher.Lane` list the batcher
+    popped (``1 <= len <= batch_max``), each carrying its memoized
+    ``staged`` tuple.  The batch is padded to EXACTLY
+    ``core.config.batch_max`` lanes by tiling the real ones (pad results
+    are discarded), solved via ``sweep_designs(health=True)``, and sliced
+    back: ``rows[i]`` is lane ``i``'s client-facing result dict.  ``info``
+    carries the batch-level health/occupancy block for metrics & stats.
+    """
+    import numpy as np
+
+    from raft_tpu.parallel.sweep import sweep_designs
+
+    cfg = core.config
+    B = len(lanes)
+    # a refresh may shrink the capacity while an old-capacity batch is
+    # already popped: pad to whichever is larger, so every interleaving
+    # of the (config, batcher) updates solves — a transient batch just
+    # keys its own signature
+    cap = max(cfg.batch_max, B)
+    staged = [ln.staged for ln in lanes]
+    labels = [ln.label for ln in lanes]
+    # fixed-capacity padding: tile the real lanes cyclically.  Pad lanes
+    # recompute a real lane's physics and are discarded — the price of
+    # one executable per bucket across every occupancy.
+    for j in range(cap - B):
+        staged.append(staged[j % B])
+        labels.append(f"<pad:{labels[j % B]}>")
+    batch = _stack_batch(sig, staged, labels, cfg.nw)
+    out = sweep_designs(staged={sig: batch}, n_iter=cfg.n_iter,
+                        return_xi=False, health=True,
+                        escalate=cfg.escalate, chunk=cfg.chunk)
+    conv = np.asarray(out["converged"]).astype(bool)
+    finite = np.asarray(out["finite"]).astype(bool)
+    h = out["health"]
+    quarantined = set(h["quarantined"])
+    unsalvaged = set(h["unsalvaged"])
+    rows = []
+    for i in range(B):
+        rows.append({
+            "design": labels[i],
+            "std_dev": np.asarray(out["std dev"][i]).tolist(),
+            "iterations": int(np.asarray(out["iterations"][i])),
+            "converged": bool(conv[i]),
+            "finite": bool(finite[i]),
+            "quarantined": i in quarantined,
+            "salvaged": i in quarantined and i not in unsalvaged,
+        })
+    core.record_batch(sig, B)
+    info = {
+        "sig": tuple(sig),
+        "lanes": B,
+        "capacity": cap,
+        "occupancy": B / cap,
+        "quarantined_real": sorted(i for i in quarantined if i < B),
+        "rungs_used": h.get("rungs_used", {}),
+    }
+    return rows, info
+
+
+def solve_solo(core: SolverCore, design, Hs: float, Tp: float):
+    """One request solved through the EXACT batch path, alone: a
+    single-lane batch padded to capacity.  The reference the determinism
+    tests hold mixed batches to — a lane's row from any batch must be
+    bit-identical to its solo row — and the sequential baseline of the
+    serving bench."""
+    from raft_tpu.serve.batcher import Lane
+
+    sig, staged = core.stage_lane(design, Hs, Tp)
+    lane = Lane(request_id="solo", seq=0, label=design_key(design)[-24:],
+                staged=staged)
+    rows, _info = solve_batch(core, sig, [lane])
+    return rows[0]

@@ -138,6 +138,29 @@ def test_spawn_full_bench_guards(tmp_path, monkeypatch):
     assert "died" in err["stderr_tail"]
 
 
+def test_device_child_timeout_clamped_to_remaining_budget():
+    """The device child's wall-clock is the REMAINING budget after the
+    CPU-rescue reserve — and when that leaves less than the 60 s floor
+    the child is SKIPPED (None), never granted a floor that overshoots
+    the driver budget (ADVICE round-5: max(60, remaining) used to)."""
+    import importlib.util
+
+    spec = importlib.util.spec_from_file_location(
+        "bench_mod2", os.path.join(REPO, "bench.py"))
+    bench = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(bench)
+
+    # plenty of budget: child gets exactly what remains
+    assert bench._device_child_timeout(1200.0, 10.0) == pytest.approx(950.0)
+    # exactly at the floor: still allowed
+    assert bench._device_child_timeout(310.0, 10.0) == pytest.approx(60.0)
+    # below the floor after the reserve: SKIP, not a 60 s grant
+    assert bench._device_child_timeout(309.0, 10.0) is None
+    assert bench._device_child_timeout(200.0, 0.0) is None
+    # a tiny driver budget can never produce a positive child window
+    assert bench._device_child_timeout(60.0, 0.0) is None
+
+
 def test_dryrun_cpu_device_plan_selection():
     """Non-slow pin on the jax-0.4.37 dryrun fix: the mesh-mechanism
     fallback must select correctly in every regime (first-class

@@ -1,0 +1,562 @@
+"""Resident solver service: protocol, deterministic batching, padded-batch
+bit-identity, socket end-to-end, knob snapshot, executor refresh.
+
+The determinism contract under test (docs/serving.rst):
+
+* same arrival schedule + knobs -> IDENTICAL batch compositions
+  (:class:`MicroBatcher` on a virtual clock — deadline-close,
+  capacity-close, and mixed-bucket interleave cases);
+* a request's results are BIT-IDENTICAL whatever batch it rode in
+  (fixed-capacity padding + value-independent vmapped lanes), pinned by
+  solving the same lane solo and in mixed company;
+* a NaN lane is quarantined without perturbing batch-mates' bits.
+"""
+import os
+import socket
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu.build.buckets import BucketSig
+from raft_tpu.serve import protocol
+from raft_tpu.serve.batcher import Lane, MicroBatcher
+from raft_tpu.serve.config import ServeConfig
+from raft_tpu.serve.solver import SolverCore, design_key, solve_batch
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+OC3 = os.path.join(REPO, "raft_tpu", "designs", "OC3spar.yaml")
+OC4 = os.path.join(REPO, "raft_tpu", "designs", "OC4semi.yaml")
+
+
+# --------------------------------------------------------------------------
+# protocol: framing + request validation
+# --------------------------------------------------------------------------
+def test_protocol_frame_round_trip():
+    a, b = socket.socketpair()
+    try:
+        msg = {"op": "ping", "id": "x", "payload": list(range(50))}
+        protocol.send_msg(a, msg)
+        assert protocol.recv_msg(b) == msg
+    finally:
+        a.close()
+        b.close()
+
+
+def test_protocol_peer_close_and_oversize():
+    a, b = socket.socketpair()
+    a.close()
+    with pytest.raises(protocol.PeerClosed):
+        protocol.recv_msg(b)
+    b.close()
+    a, b = socket.socketpair()
+    try:
+        # an announced frame length past the cap must refuse BEFORE
+        # allocating/reading the body
+        import struct
+
+        a.sendall(struct.pack(">I", protocol.MAX_FRAME + 1))
+        with pytest.raises(protocol.ProtocolError):
+            protocol.recv_msg(b)
+    finally:
+        a.close()
+        b.close()
+
+
+def test_parse_request_kinds_and_errors():
+    one = protocol.parse_request(
+        {"op": "solve", "id": "a", "design": "oc3", "Hs": 6, "Tp": 10})
+    assert len(one["lanes"]) == 1
+    assert one["lanes"][0][0].endswith("OC3spar.yaml")
+    dlc = protocol.parse_request(
+        {"op": "dlc", "id": "b", "design": OC4,
+         "cases": [[6, 10], [8, 12]]})
+    assert len(dlc["lanes"]) == 2
+    sw = protocol.parse_request(
+        {"op": "sweep", "id": "c", "designs": ["oc3", "volturnus"],
+         "Hs": 7, "Tp": 11})
+    assert [l[1] for l in sw["lanes"]] == ["OC3spar", "VolturnUS-S"]
+    assert protocol.parse_request({"op": "ping"})["lanes"] == []
+    for bad in (
+        {"op": "nope"},
+        {"op": "solve", "design": "oc3", "Hs": 6, "Tp": 10},   # no id
+        {"op": "solve", "id": "x", "design": "mystery", "Hs": 6, "Tp": 10},
+        {"op": "solve", "id": "x", "design": "oc3", "Hs": "wide", "Tp": 1},
+        {"op": "dlc", "id": "x", "design": "oc3", "cases": []},
+        {"op": "dlc", "id": "x", "design": "oc3", "cases": [[1, 2, 3]]},
+        {"op": "sweep", "id": "x", "designs": [], "Hs": 6, "Tp": 10},
+        [1, 2],
+    ):
+        with pytest.raises(protocol.ProtocolError):
+            protocol.parse_request(bad)
+
+
+def test_design_key_dict_content_hash():
+    d1 = {"a": 1, "b": [1, 2]}
+    d2 = {"b": [1, 2], "a": 1}          # key order must not matter
+    assert design_key(d1) == design_key(d2)
+    assert design_key(d1) != design_key({"a": 2, "b": [1, 2]})
+    assert design_key("/p/x.yaml") == "/p/x.yaml"
+
+
+# --------------------------------------------------------------------------
+# micro-batcher: deterministic deadline/capacity composition
+# --------------------------------------------------------------------------
+class VirtualClock:
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+
+SIG_A = BucketSig(16, 64, 32)
+SIG_B = BucketSig(48, 128, 32)
+
+
+def _lane(i):
+    return Lane(request_id=i, seq=0, label=f"l{i}", staged=None)
+
+
+def _run_schedule(events, deadline=10.0, cap=3):
+    """Replay [(t, sig, lane_id) ...] arrivals plus ('advance', t) steps
+    on a virtual clock; after each step drain every closeable batch.
+    Returns the closed compositions [(sig, [lane ids]) ...]."""
+    clk = VirtualClock()
+    mb = MicroBatcher(batch_deadline_s=deadline, batch_max=cap, clock=clk)
+    out = []
+
+    def drain_ready():
+        while True:
+            got = mb.next_batch(timeout=0.0)
+            if got is None:
+                return
+            out.append((tuple(got[0]), [ln.request_id for ln in got[1]]))
+
+    for ev in events:
+        if ev[0] == "advance":
+            clk.t = ev[1]
+        else:
+            t, sig, lid = ev
+            clk.t = t
+            mb.submit(sig, _lane(lid))
+        drain_ready()
+    return out
+
+
+def test_batcher_capacity_close_fifo_and_remainder():
+    events = [(0.0, SIG_A, i) for i in range(5)]     # cap 3: one close
+    got = _run_schedule(events, deadline=100.0, cap=3)
+    assert got == [(tuple(SIG_A), [0, 1, 2])]
+    # the remainder keeps its ORIGINAL arrival: deadline measured from
+    # t=0, so advancing to 100 closes [3, 4]
+    got2 = _run_schedule(events + [("advance", 100.0)],
+                         deadline=100.0, cap=3)
+    assert got2 == [(tuple(SIG_A), [0, 1, 2]), (tuple(SIG_A), [3, 4])]
+
+
+def test_batcher_deadline_close():
+    events = [(0.0, SIG_A, 0), (2.0, SIG_A, 1), ("advance", 9.9)]
+    assert _run_schedule(events, deadline=10.0) == []   # not yet
+    events += [("advance", 10.0)]
+    assert _run_schedule(events, deadline=10.0) == [
+        (tuple(SIG_A), [0, 1])]
+
+
+def test_batcher_mixed_bucket_interleave_deterministic():
+    events = [
+        (0.0, SIG_A, 0), (1.0, SIG_B, 1), (2.0, SIG_A, 2),
+        (3.0, SIG_B, 3), (4.0, SIG_A, 4),          # A capacity-closes
+        (5.0, SIG_B, 5), ("advance", 11.5),        # B deadline-closes
+        (12.0, SIG_A, 6), ("advance", 30.0),
+    ]
+    expect = [
+        (tuple(SIG_A), [0, 2, 4]),                 # capacity at t=4
+        (tuple(SIG_B), [1, 3, 5]),                 # deadline at 1+10
+        (tuple(SIG_A), [6]),                       # deadline at 12+10
+    ]
+    runs = [_run_schedule(events, deadline=10.0, cap=3) for _ in range(3)]
+    assert runs[0] == expect
+    assert runs[1] == runs[0] and runs[2] == runs[0]
+
+
+def test_batcher_simultaneous_deadlines_tie_break_stable():
+    # both buckets deadline-expire at the same instant: equal oldest
+    # arrivals fall through to the sorted-signature tie break (SIG_A <
+    # SIG_B) — a total order, same composition every run
+    events = [(0.0, SIG_B, 0), (0.0, SIG_A, 1), ("advance", 10.0)]
+    got = _run_schedule(events, deadline=10.0)
+    assert got == [(tuple(SIG_A), [1]), (tuple(SIG_B), [0])]
+
+
+def test_batcher_close_drains_then_signals_exit():
+    clk = VirtualClock()
+    mb = MicroBatcher(batch_deadline_s=100.0, batch_max=8, clock=clk)
+    mb.submit(SIG_A, _lane(0))
+    mb.submit(SIG_B, _lane(1))
+    mb.close()
+    sigs = {tuple(mb.next_batch()[0]) for _ in range(2)}
+    assert sigs == {tuple(SIG_A), tuple(SIG_B)}
+    assert mb.next_batch() is None
+    with pytest.raises(RuntimeError):
+        mb.submit(SIG_A, _lane(2))
+    assert mb.counters() == {"submitted": 2, "popped": 2, "pending": 0}
+
+
+# --------------------------------------------------------------------------
+# config snapshot (GL303: env read once, at arm time)
+# --------------------------------------------------------------------------
+def test_config_from_env_snapshot(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_SERVE_BATCH_DEADLINE_MS", "40")
+    monkeypatch.setenv("RAFT_TPU_SERVE_BATCH_MAX", "5")
+    monkeypatch.setenv("RAFT_TPU_SERVE_SOCKET", "/tmp/x.sock")
+    cfg = ServeConfig.from_env(nw=8)
+    assert (cfg.batch_deadline_s, cfg.batch_max, cfg.socket_path,
+            cfg.nw) == (0.040, 5, "/tmp/x.sock", 8)
+    # a mid-process env change must not reach the snapshot
+    monkeypatch.setenv("RAFT_TPU_SERVE_BATCH_MAX", "99")
+    assert cfg.batch_max == 5
+    # overrides win over env
+    assert ServeConfig.from_env(batch_max=2).batch_max == 2
+    monkeypatch.setenv("RAFT_TPU_SERVE_BATCH_MAX", "zero")
+    with pytest.raises(ValueError):
+        ServeConfig.from_env()
+    monkeypatch.setenv("RAFT_TPU_SERVE_BATCH_MAX", "0")
+    with pytest.raises(ValueError):
+        ServeConfig.from_env()
+
+
+# --------------------------------------------------------------------------
+# solver: staging memo + padded-batch bit-identity
+# --------------------------------------------------------------------------
+@pytest.fixture(scope="module")
+def core3():
+    """One warm SolverCore shared by the solver tests (tiny program:
+    nw=8 physical -> 16 padded, 4 iterations, capacity 3)."""
+    cfg = ServeConfig(batch_deadline_s=0.01, batch_max=3, nw=8,
+                      w_min=0.3, w_max=2.1, n_iter=8, escalate=False)
+    return SolverCore(cfg)
+
+
+def _mk_lane(core, design, Hs, Tp, rid="r"):
+    sig, staged = core.stage_lane(design, Hs, Tp)
+    return sig, Lane(request_id=rid, seq=0, label=str(rid), staged=staged)
+
+
+def test_stage_lane_memo_and_routing(core3):
+    sig_a, st = core3.stage_lane(OC3, 6.0, 10.0)
+    sig_a2, st2 = core3.stage_lane(OC3, 6.0, 10.0)
+    assert st is st2, "repeated (design, sea state) must hit the memo"
+    sig_b, _ = core3.stage_lane(OC4, 6.0, 10.0)
+    assert sig_a == sig_a2
+    assert sig_a != sig_b, "OC3 and OC4 must route to different buckets"
+    # different sea state = different staging, same bucket
+    sig_a3, st3 = core3.stage_lane(OC3, 7.0, 11.0)
+    assert sig_a3 == sig_a and st3 is not st
+
+
+def test_solve_batch_rows_and_occupancy(core3):
+    sig, lane = _mk_lane(core3, OC3, 6.0, 10.0)
+    rows, info = solve_batch(core3, sig, [lane])
+    assert len(rows) == 1
+    r = rows[0]
+    assert r["converged"] and r["finite"] and not r["quarantined"]
+    assert len(r["std_dev"]) == 6 and np.isfinite(r["std_dev"]).all()
+    assert info["lanes"] == 1 and info["capacity"] == 3
+    assert info["occupancy"] == pytest.approx(1 / 3)
+
+
+def test_lane_results_batch_composition_independent(core3):
+    """THE serving determinism pin: one lane's row is bit-identical
+    solo (padded with copies of itself) and in mixed company."""
+    sig, lane_a = _mk_lane(core3, OC3, 6.0, 10.0, "a")
+    solo_rows, _ = solve_batch(core3, sig, [lane_a])
+    # mixed company: a different sea state of the same bucket, twice
+    _, lane_b = _mk_lane(core3, OC3, 7.5, 11.0, "b")
+    _, lane_c = _mk_lane(core3, OC3, 9.0, 12.5, "c")
+    mixed_rows, info = solve_batch(core3, sig, [lane_a, lane_b, lane_c])
+    assert info["occupancy"] == 1.0
+    assert mixed_rows[0]["std_dev"] == solo_rows[0]["std_dev"], \
+        "batch-mates changed a lane's bits"
+    assert mixed_rows[0]["iterations"] == solo_rows[0]["iterations"]
+    # and the mixed order is respected: b/c rows differ from a's
+    assert mixed_rows[1]["std_dev"] != mixed_rows[0]["std_dev"]
+    # b solo must equal b-in-mixed too (capacity-close vs deadline-close
+    # compositions can never change results)
+    solo_b, _ = solve_batch(core3, sig, [_mk_lane(core3, OC3, 7.5, 11.0)[1]])
+    assert solo_b[0]["std_dev"] == mixed_rows[1]["std_dev"]
+
+
+def test_solve_batch_parity_vs_sweep_designs(core3):
+    """The serve path IS sweep_designs + padding: a serve row must match
+    the plain mixed-design API at float eps (different batch size, same
+    per-lane program)."""
+    from raft_tpu.parallel.sweep import sweep_designs
+
+    sig, lane = _mk_lane(core3, OC3, 6.0, 10.0)
+    rows, _ = solve_batch(core3, sig, [lane])
+    ref = sweep_designs([OC3], nw=8, Hs=6.0, Tp=10.0, w_min=0.3,
+                        w_max=2.1, n_iter=8, return_xi=False)
+    got = np.asarray(rows[0]["std_dev"])
+    want = np.asarray(ref["std dev"][0])
+    scale = np.max(np.abs(want))
+    assert np.max(np.abs(got - want)) <= 1e-9 * scale
+
+
+def test_nan_lane_quarantined_mates_bitwise(core3):
+    """One client's Tp=0 lane (NaN JONSWAP spectrum) is quarantined;
+    its batch-mate's bits do not move."""
+    sig, good = _mk_lane(core3, OC3, 6.0, 10.0, "good")
+    solo_rows, _ = solve_batch(core3, sig, [good])
+    _, bad = _mk_lane(core3, OC3, 6.0, 0.0, "bad")
+    rows, info = solve_batch(core3, sig, [good, bad])
+    assert rows[0]["finite"] and not rows[0]["quarantined"]
+    assert rows[0]["std_dev"] == solo_rows[0]["std_dev"]
+    assert rows[1]["quarantined"] and not rows[1]["finite"]
+    assert not rows[1]["salvaged"]          # escalate=False in core3
+    assert 1 in info["quarantined_real"]
+
+
+def test_solver_refresh_drops_memo(core3):
+    core3.stage_lane(OC3, 6.0, 10.0)
+    info = core3.refresh()
+    assert info["staged_lanes_dropped"] >= 1
+    _sig, st = core3.stage_lane(OC3, 6.0, 10.0)
+    assert st is core3.stage_lane(OC3, 6.0, 10.0)[1]
+
+
+# --------------------------------------------------------------------------
+# end-to-end over the real socket: two concurrent clients
+# --------------------------------------------------------------------------
+@pytest.fixture()
+def server(tmp_path):
+    from raft_tpu.serve.server import SolverServer
+
+    cfg = ServeConfig(batch_deadline_s=0.02, batch_max=3, nw=8,
+                      w_min=0.3, w_max=2.1, n_iter=8, escalate=False,
+                      socket_path=str(tmp_path / "serve.sock"))
+    srv = SolverServer(cfg)
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def test_two_clients_concurrent_submit(server):
+    from raft_tpu.serve.client import SolveClient
+
+    sock = server.socket_path
+    results = {}
+    errors = []
+
+    def client_run(name, Hs):
+        try:
+            with SolveClient(sock) as cl:
+                futs = [cl.submit({"op": "solve", "design": "oc3",
+                                   "Hs": Hs + 0.5 * j, "Tp": 10.0})
+                        for j in range(3)]
+                dlc = cl.submit({"op": "dlc", "design": "oc3",
+                                 "cases": [[Hs, 10.0], [Hs + 1.0, 12.0]]})
+                rs = [f.result(180.0) for f in futs] + [dlc.result(180.0)]
+                results[name] = rs
+        except Exception as e:          # surfaced by the join below
+            errors.append(f"{name}: {type(e).__name__}: {e}")
+
+    t1 = threading.Thread(target=client_run, args=("c1", 6.0))
+    t2 = threading.Thread(target=client_run, args=("c2", 8.0))
+    t1.start()
+    t2.start()
+    t1.join(300)
+    t2.join(300)
+    assert not errors, errors
+    assert set(results) == {"c1", "c2"}
+    for name, rs in results.items():
+        for r in rs[:3]:
+            assert r["ok"], r
+            assert len(r["results"]) == 1
+            assert r["results"][0]["converged"]
+        dlc = rs[3]
+        assert dlc["ok"] and len(dlc["results"]) == 2
+        assert len(dlc["t_queue_s"]) == 2
+    # distinct sea states must produce distinct rows (no cross-request
+    # result mixing under concurrent submits)
+    c1_first = results["c1"][0]["results"][0]["std_dev"]
+    c2_first = results["c2"][0]["results"][0]["std_dev"]
+    assert c1_first != c2_first
+
+
+def test_server_stats_refresh_and_bad_request(server):
+    from raft_tpu.serve.client import SolveClient
+
+    with SolveClient(server.socket_path) as cl:
+        assert cl.ping()["ok"]
+        r = cl.solve("oc3", 6.0, 10.0)
+        assert r["ok"]
+        st = cl.stats()
+        assert st["ok"] and st["solver"]["buckets"]
+        assert st["solver"]["batch_max"] == 3
+        # malformed request: error response, connection stays usable
+        bad = cl.call({"op": "solve", "design": "mystery",
+                       "Hs": 6, "Tp": 10})
+        assert not bad["ok"] and "mystery" in bad["error"]["detail"]
+        assert cl.ping()["ok"]
+        # refresh with operator-carried knob values
+        rf = cl.call({"op": "refresh", "deadline_ms": 5, "batch_max": 2})
+        assert rf["ok"] and rf["batch_max"] == 2
+        assert server.batcher.batch_max == 2
+        assert server.core.config.batch_max == 2
+        r2 = cl.solve("oc3", 6.0, 10.0)      # new capacity still solves
+        assert r2["ok"]
+
+
+def test_partial_batch_failure_poisons_whole_request(server, monkeypatch):
+    """A sweep spanning two buckets where ONE bucket's batch fails must
+    answer ok:false — never ok:true with null rows for the failed
+    lanes."""
+    from raft_tpu.serve import server as server_mod
+    from raft_tpu.serve.client import SolveClient
+
+    real = server_mod.solve_batch
+    oc4_sig = server.core.stage_lane(OC4, 6.0, 10.0)[0]
+
+    def flaky(core, sig, lanes):
+        if sig == oc4_sig:
+            raise RuntimeError("injected bucket failure")
+        return real(core, sig, lanes)
+
+    monkeypatch.setattr(server_mod, "solve_batch", flaky)
+    with SolveClient(server.socket_path) as cl:
+        r = cl.call({"op": "sweep", "designs": ["oc3", "oc4"],
+                     "Hs": 6.0, "Tp": 10.0}, timeout=180.0)
+        assert not r["ok"]
+        assert "injected bucket failure" in r["error"]["detail"]
+        # the connection survives and healthy buckets still serve
+        ok = cl.solve("oc3", 6.0, 10.0, timeout=180.0)
+        assert ok["ok"] and ok["results"][0]["converged"]
+
+
+def test_refresh_rejects_malformed_values(server):
+    """Malformed refresh values answer with an error response; they must
+    not kill the reader thread (which would drop the connection)."""
+    from raft_tpu.serve.client import SolveClient
+
+    with SolveClient(server.socket_path) as cl:
+        r = cl.call({"op": "refresh", "deadline_ms": "abc"})
+        assert not r["ok"] and r["error"]["class"] == "ValueError"
+        r2 = cl.call({"op": "refresh", "batch_max": 0})
+        assert not r2["ok"]
+        assert cl.ping()["ok"]          # connection still alive
+        # server state untouched by the rejected values
+        assert server.batcher.batch_max == 3
+
+
+def test_shutdown_op_drains(tmp_path):
+    from raft_tpu.serve.client import SolveClient
+    from raft_tpu.serve.server import SolverServer
+
+    cfg = ServeConfig(batch_deadline_s=0.02, batch_max=2, nw=8,
+                      w_min=0.3, w_max=2.1, n_iter=8, escalate=False,
+                      socket_path=str(tmp_path / "s.sock"))
+    srv = SolverServer(cfg)
+    srv.start()
+    with SolveClient(cfg.socket_path) as cl:
+        fut = cl.submit({"op": "solve", "design": "oc3",
+                         "Hs": 6.0, "Tp": 10.0})
+        ack = cl.shutdown()
+        assert ack["ok"]
+        # the queued request is answered before the daemon exits
+        r = fut.result(180.0)
+        assert r["ok"] and r["results"][0]["converged"]
+    assert srv.wait(60.0)
+    # stop() unlinks the socket just after the solver drain signals —
+    # poll out the last few milliseconds of the stop thread
+    import time as _time
+
+    deadline = _time.monotonic() + 10.0
+    while os.path.exists(cfg.socket_path) and _time.monotonic() < deadline:
+        _time.sleep(0.02)
+    assert not os.path.exists(cfg.socket_path)
+
+
+# --------------------------------------------------------------------------
+# loadgen: closed-form schedule + deterministic quantiles
+# --------------------------------------------------------------------------
+def test_loadgen_schedule_closed_form():
+    from raft_tpu.serve import loadgen
+
+    a = [loadgen.schedule(i, 50.0) for i in range(20)]
+    b = [loadgen.schedule(i, 50.0) for i in range(20)]
+    assert a == b
+    designs = {d for d, *_ in a}
+    assert designs == set(loadgen.DEFAULT_DESIGNS)
+    assert a[0][3] == 0.0 and a[10][3] == pytest.approx(0.2)
+
+
+def test_loadgen_quantile_rank_statistic():
+    from raft_tpu.serve.loadgen import quantile
+
+    xs = [5.0, 1.0, 3.0, 2.0, 4.0]
+    assert quantile(xs, 0.5) == 3.0
+    assert quantile(xs, 0.99) == 5.0
+    assert quantile(xs, 0.0) == 1.0
+    assert quantile([7.0], 0.99) == 7.0
+    assert np.isnan(quantile([], 0.5))
+
+
+# --------------------------------------------------------------------------
+# cache: tag-scoped executor eviction (the graceful-refresh primitive)
+# --------------------------------------------------------------------------
+def test_evict_memory_tag_scoped(tmp_path):
+    import jax.numpy as jnp
+
+    from raft_tpu import cache
+    from raft_tpu.cache import aot
+
+    cache.enable(str(tmp_path / "c"))
+    try:
+        aot.clear_memory()
+        args = (jnp.arange(4, dtype=jnp.float32),)
+        f1 = aot.cached_compile("serve_evict_a", lambda x: x + 1, args)
+        f2 = aot.cached_compile("serve_evict_b", lambda x: x * 2, args)
+        assert aot.cached_compile("serve_evict_a", lambda x: x + 1,
+                                  args) is f1
+        # evicting tag b leaves tag a memoized
+        assert cache.evict_memory("serve_evict_b") == 1
+        assert aot.cached_compile("serve_evict_a", lambda x: x + 1,
+                                  args) is f1
+        # b re-resolves from DISK: a fresh object, but zero new compiles
+        c0 = aot.compile_count("serve_evict_b")
+        f2b = aot.cached_compile("serve_evict_b", lambda x: x * 2, args)
+        assert f2b is not f2
+        assert aot.compile_count("serve_evict_b") == c0
+        # full eviction
+        assert cache.evict_memory() == 2
+    finally:
+        aot.clear_memory()
+        cache.disable()
+
+
+# --------------------------------------------------------------------------
+# docs drift: the serving knob table is generated from the registry
+# --------------------------------------------------------------------------
+def test_serving_docs_knob_table_in_sync():
+    from raft_tpu.lint import knobs
+
+    path = os.path.join(REPO, "docs", "serving.rst")
+    block = knobs.rendered_docs_block(open(path, encoding="utf-8").read())
+    assert block is not None, "serving.rst lost its AUTOGEN markers"
+    assert block.strip() == knobs.rst_table(
+        knobs.serve_knob_names()).strip(), (
+        "docs/serving.rst knob table is stale — run "
+        "`python -m raft_tpu.lint.knobs`")
+    assert "RAFT_TPU_SERVE_BATCH_DEADLINE_MS" in block
+
+
+def test_serve_smoke_stream_is_mixed_and_closed_form():
+    from raft_tpu.serve import smoke
+
+    assert len(smoke.STREAM) == 9
+    assert {d for d, _h, _t in smoke.STREAM} == {"oc3", "oc4", "volturnus"}
+    # closed form: a re-import cannot change the stream
+    again = [(d, 6.0 + 0.5 * (i % 3), 10.0 + 0.5 * (i % 2))
+             for i, d in enumerate(["oc3", "oc4", "volturnus"] * 3)]
+    assert smoke.STREAM == again

@@ -31,8 +31,8 @@ obs-smoke:       ## observability proof: RAFT_TPU_OBS-armed sweep emits valid
 race-smoke:      ## deterministic N-thread race proof: single-flight AOT compile,
 	python -m raft_tpu.lint.race     # exact metric/ckpt/fault counters (< 60 s CPU)
 
-serve-smoke:     ## resident-daemon proof: mixed stream compiles == buckets, parity
-	python -m raft_tpu.serve smoke   # vs solo, SIGTERM -> warm restart 0 compiles
+serve-smoke:     ## resident-daemon proof: compiles == buckets, solo parity, warm
+	python -m raft_tpu.serve smoke   # restart 0 compiles; armed obs leg: request traces/SLO/flight/ledger
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

@@ -689,16 +689,20 @@ def serving_block(n_requests: int = 48, rate: float = 400.0,
         # warm pass: staging memo + executables hot, results discarded
         loadgen.run_open_loop(cl, n_requests, rate, **sched_kw)
         srv.core.reset_stats()
+        srv.reset_telemetry()        # SLO window starts at the measured pass
         open_out, _results = loadgen.run_open_loop(cl, n_requests, rate,
                                                    **sched_kw)
-        # occupancy snapshot BEFORE the sequential leg: its 1-lane
-        # batches would dilute the open-loop occupancy claim
+        # occupancy + SLO snapshots BEFORE the sequential leg: its
+        # 1-lane batches would dilute the open-loop occupancy claim,
+        # and its completion-driven latencies would pollute the window
         open_stats = srv.core.stats()
+        open_tel = srv.telemetry()
         seq_out = loadgen.run_sequential(cl, max(6, n_requests // 4),
                                          rate, **sched_kw)
-        return open_out, seq_out, open_stats
+        return open_out, seq_out, open_stats, open_tel
 
-    (open_out, seq_out, open_stats), _stats, _ready = run_server(measure)
+    ((open_out, seq_out, open_stats, open_tel),
+     _stats, _ready) = run_server(measure)
     stats = open_stats
     compiles = cache.compile_count("sweep_designs") - c0
 
@@ -718,6 +722,64 @@ def serving_block(n_requests: int = 48, rate: float = 400.0,
         os.rmdir(os.path.dirname(sock))
     except OSError:
         pass
+
+    # ---- windowed SLO: the server's sliding-window quantiles
+    # cross-checked against the loadgen's client-side rank quantiles
+    # (the window covers the warm + measured passes of the SAME
+    # schedule; the server quantile is a log-bucket upper edge, i.e. at
+    # most ~26% above the true value, and the client latency includes
+    # the socket round-trip on top of the server's) ----
+    win = open_tel.get("latency", {})
+    client_p50 = open_out.get("latency_p50_s")
+    slo = {
+        "window_s": open_tel.get("window_s"),
+        "server_p50_s": win.get("p50"),
+        "server_p99_s": win.get("p99"),
+        "server_count": win.get("count"),
+        "server_error_rate": win.get("error_rate"),
+        "client_p50_s": client_p50,
+        "client_p99_s": open_out.get("latency_p99_s"),
+        "server_vs_client_p50": (
+            round(win["p50"] / client_p50, 3)
+            if win.get("p50") and client_p50 else None),
+        # the server histogram reports a log-bucket UPPER edge (5
+        # buckets/decade: at most 10^(1/5) ~ 1.585x above the true
+        # value), and the true server latency is <= the client's (the
+        # client adds the socket round-trip and schedule lag) — so the
+        # reported server p50 can never legitimately exceed the
+        # client's by more than one bucket of quantization
+        "consistent_with_client": (
+            bool(win.get("p50", 0) > 0 and client_p50
+                 and win["p50"] <= client_p50 * 1.585 + 0.05)),
+        "error_budget": open_tel.get("error_budget"),
+    }
+
+    # ---- measured-performance ledger: achieved FLOP/s + roofline
+    # fraction per warm bucket, persisted next to the AOT cache (null
+    # when the warm-start cache is off — no artifact identity to key
+    # by, hetero_buckets precedent) ----
+    from raft_tpu import obs as _obs
+
+    ledger_block = None
+    if cache.is_enabled():
+        # best-effort like every other telemetry call site: a malformed
+        # RAFT_TPU_ROOFLINE (flush raises at peak-model time) must
+        # degrade this block to an error note, never discard the whole
+        # bench's already-computed workload results
+        try:
+            _obs.ledger.flush()
+            ledger_block = [{
+                "bucket": e.get("bucket"),
+                "count": e.get("count"),
+                "best_s": e.get("best_s"),
+                "achieved_flops_per_s": e.get("achieved_flops_per_s"),
+                "achieved_bytes_per_s": e.get("achieved_bytes_per_s"),
+                "roofline_fraction": e.get("roofline_fraction"),
+                "peak_source": (e.get("peak") or {}).get("source"),
+            } for e in _obs.ledger.entries()
+                if e.get("entry") == "sweep_designs"]
+        except Exception as e:
+            ledger_block = {"error": f"{type(e).__name__}: {str(e)[-200:]}"}
     return {
         "nw": nw,
         "n_iter": n_iter,
@@ -743,6 +805,8 @@ def serving_block(n_requests: int = 48, rate: float = 400.0,
             "ready_s": round(restart_ready_s, 3),
             "compiles": (restart_compiles if cache.is_enabled() else None),
         },
+        "slo": slo,
+        "ledger": ledger_block,
     }
 
 
@@ -1146,8 +1210,9 @@ def main():
                 out["tpu_retry"] = retry_err
         # with RAFT_TPU_OBS armed, the bench additionally leaves the
         # JSONL event log + Chrome trace + Prometheus snapshot behind
-        # (no-op when the knob is off — the default)
-        _obs.maybe_publish("bench")
+        # (no-op when the knob is off — the default; forced past the
+        # auto-publish debounce so the final snapshot is complete)
+        _obs.maybe_publish("bench", force=True)
         print(json.dumps(out))
     except Exception as e:  # emit a diagnostic line, not a stack trace
         # (a child with ASSUME_DEVICE lands here on a mid-bench device

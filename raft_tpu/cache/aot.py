@@ -128,6 +128,54 @@ def reset_compile_events() -> None:
         _compile_counts.clear()
 
 
+# compiler-side accounting (flops / bytes / memory) of resolved
+# executables, memoized per live object: the performance ledger joins
+# these numbers with measured dispatch times, and the cost_analysis walk
+# should run once per executable, not once per dispatch.  Bounded like
+# every other process-lifetime buffer.
+_COST_MEMO_MAX = 512
+_cost_lock = threading.Lock()
+_cost_memo: dict = {}            # id(compiled) -> (weakref, metrics)
+
+
+def artifact_cost(compiled) -> dict | None:
+    """The budget-gate extraction (``lint.audit.compiled_metrics``),
+    live: ``cost_analysis``/``memory_analysis`` metrics of one resolved
+    executable — works on freshly-compiled AND deserialized-from-disk
+    artifacts.  Returns None for a plain jitted function (cache
+    disabled) or when the backend reports nothing usable.  Memoized by
+    object identity (weakref-checked, so a recycled ``id`` can never
+    serve another executable's numbers)."""
+    import weakref
+
+    if not hasattr(compiled, "cost_analysis"):
+        return None
+    key = id(compiled)
+    with _cost_lock:
+        hit = _cost_memo.get(key)
+        if hit is not None and hit[0]() is compiled:
+            return hit[1]
+    from raft_tpu.lint.audit import compiled_metrics
+
+    try:
+        m = compiled_metrics(compiled, 0, 0)
+    except Exception:                # pragma: no cover - backend quirk
+        return None
+    m.pop("n_eqns", None)
+    m.pop("n_jaxprs", None)
+    if not m:
+        return None
+    try:
+        ref = weakref.ref(compiled)
+    except TypeError:                # pragma: no cover - unweakrefable
+        return m
+    with _cost_lock:
+        if len(_cost_memo) >= _COST_MEMO_MAX:
+            _cost_memo.pop(next(iter(_cost_memo)))
+        _cost_memo[key] = (ref, m)
+    return m
+
+
 def _version_salts() -> tuple:
     import jax
 

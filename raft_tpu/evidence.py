@@ -169,10 +169,18 @@ def main():
             bench["obs"] = ob
         # resident-service block (open-loop p50/p99 + solves/s vs the
         # sequential baseline, per-bucket occupancy, compile collapse,
-        # warm-restart): the serving story one key deep as well
+        # warm-restart, windowed server-side SLO cross-checked against
+        # the client quantiles, measured-performance ledger rooflines):
+        # the serving story one key deep as well
         sv = bench_json.get("workloads", {}).get("serving")
         if sv is not None:
             bench["serving"] = sv
+            # the two new measured claims ride one key deep themselves:
+            # windowed SLO consistency and per-bucket roofline fractions
+            if sv.get("slo") is not None:
+                bench["serving_slo"] = sv["slo"]
+            if sv.get("ledger") is not None:
+                bench["serving_ledger"] = sv["ledger"]
     else:
         bench["ok"] = False
         bench["error"] = "no JSON line found on bench stdout"

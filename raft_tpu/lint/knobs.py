@@ -88,6 +88,16 @@ KNOBS: tuple[Knob, ...] = (
          "Durable chunk checkpoint store ('1' = cache root, or a path)"),
     Knob("RAFT_TPU_OBS", "off", "obs.export", HOST,
          "Observability export sink ('1' = cache root obs/, or a directory)"),
+    # Snapshotted ONCE at first use (the arm-time contract): the
+    # concurrent sweep/serve paths reach maybe_publish / ledger.flush,
+    # and neither may re-read the environment mid-process.
+    Knob("RAFT_TPU_OBS_FLUSH_MS", "1000 ms", "obs.export", HOST,
+         "Monotonic-clock debounce of per-sweep auto-publish (forced "
+         "publishes at phase ends always write)"),
+    Knob("RAFT_TPU_ROOFLINE", "built-in per-device table", "obs.ledger",
+         HOST,
+         "Peak '<flops>:<bytes/s>' override for the measured-performance "
+         "ledger's roofline fractions"),
     Knob("RAFT_TPU_PIPELINE_DEPTH", "2", "parallel.pipeline", HOST,
          "Dispatch-ahead window of the chunked executor (min 1)"),
     Knob("RAFT_TPU_STRICT", "on", "resilience.health", HOST,

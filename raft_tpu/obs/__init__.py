@@ -4,28 +4,47 @@ One subsystem replaces the scattered ad-hoc telemetry (module-global
 phase timers, pipeline stats dicts, compile-event rings, health
 summaries) with a shared schema and export path:
 
-* :mod:`raft_tpu.obs.trace` — thread-safe nested span tracing with a
-  Chrome trace-event exporter (Perfetto-loadable);
-* :mod:`raft_tpu.obs.metrics` — process-wide counters, gauges, and
-  log-bucket latency histograms with deterministic quantiles;
+* :mod:`raft_tpu.obs.trace` — thread-safe nested span tracing with
+  request-scoped trace ids that cross threads (context tokens +
+  synthetic request tracks) and a Chrome trace-event exporter
+  (Perfetto-loadable, thread-name metadata included);
+* :mod:`raft_tpu.obs.metrics` — process-wide counters, gauges,
+  log-bucket latency histograms with deterministic quantiles, and
+  sliding-window SLO histograms (windowed p50/p99 + error rate on an
+  injectable clock);
 * :mod:`raft_tpu.obs.export` — sinks armed by ``RAFT_TPU_OBS`` (JSONL
-  event log, Chrome trace file, Prometheus text) plus the ``obs`` block
-  bench JSON / EVIDENCE.json embed.
+  event log, Chrome trace file, Prometheus text; auto-publish debounced
+  via ``RAFT_TPU_OBS_FLUSH_MS``) plus the ``obs`` block bench JSON /
+  EVIDENCE.json embed;
+* :mod:`raft_tpu.obs.flight` — bounded flight recorder of the last-N
+  completed request records, dumped atomically on error/SIGTERM/refresh;
+* :mod:`raft_tpu.obs.ledger` — measured-performance ledger joining the
+  budget gate's per-executable flops/bytes with measured dispatch
+  times into achieved FLOP/s + roofline fractions per (entry, bucket,
+  topology), persisted content-keyed next to the AOT cache.
 
 Everything here is host-side and bounded in memory; arming or reading
 it can never change a traced program, an AOT key, or a compiled
 artifact.  ``make obs-smoke`` proves the end-to-end story cross-process
 (valid exports, quantiles present, bounded overhead).
 """
-from raft_tpu.obs import export, metrics, trace                   # noqa: F401
+from raft_tpu.obs import export, flight, ledger, metrics, trace  # noqa: F401
 from raft_tpu.obs.export import (                                 # noqa: F401
     enabled, maybe_publish, obs_block, prometheus_text, publish, read_jsonl,
 )
-from raft_tpu.obs.metrics import counter, gauge, histogram, snapshot  # noqa: F401
-from raft_tpu.obs.trace import chrome_trace, span                 # noqa: F401
+from raft_tpu.obs.flight import FlightRecorder                    # noqa: F401
+from raft_tpu.obs.metrics import (                                # noqa: F401
+    counter, gauge, histogram, sliding, snapshot,
+)
+from raft_tpu.obs.trace import (                                  # noqa: F401
+    TraceContext, chrome_trace, current_context, new_trace_id, span,
+)
 
 
 def reset() -> None:
-    """Clear spans AND metrics (tests, phase boundaries of a daemon)."""
+    """Clear spans, metrics, the publish debounce, and unflushed ledger
+    aggregates (tests, phase boundaries of a daemon)."""
     trace.reset()
     metrics.reset()
+    export._reset_debounce()
+    ledger.reset()

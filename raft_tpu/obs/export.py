@@ -29,12 +29,23 @@ from __future__ import annotations
 import json
 import os
 import tempfile
+import threading
 import time
 
 from raft_tpu.obs import metrics as _metrics
 from raft_tpu.obs import trace as _trace
 
 _OFF = ("", "off", "0", "none", "disabled", "false", "no")
+
+#: knob naming the auto-publish debounce interval (milliseconds); its
+#: value is snapshotted ONCE, at the first armed publish decision (the
+#: arm-time contract) — the request path never re-reads the environment
+FLUSH_ENV = "RAFT_TPU_OBS_FLUSH_MS"
+DEFAULT_FLUSH_MS = 1000.0
+
+_flush_lock = threading.Lock()
+_flush_interval_s: list = [None]     # snapshot-once seconds
+_last_publish: list = [None]         # monotonic stamp of the last publish
 
 
 def root() -> str | None:
@@ -54,6 +65,32 @@ def root() -> str | None:
 
 def enabled() -> bool:
     return root() is not None
+
+
+def flush_interval_s() -> float:
+    """The auto-publish debounce interval (seconds), snapshotted from
+    ``RAFT_TPU_OBS_FLUSH_MS`` at first use (default 1000 ms).  PR 11's
+    smoke measured a constant ~2 ms per publish (three sink files);
+    per-sweep auto-publish on a short timed leg pays it EVERY call —
+    the debounce amortizes it to at most once per interval, while
+    forced publishes (phase ends, shutdown) always write."""
+    with _flush_lock:
+        if _flush_interval_s[0] is None:
+            raw = os.environ.get(FLUSH_ENV, "").strip()
+            try:
+                ms = float(raw) if raw else DEFAULT_FLUSH_MS
+            except ValueError:
+                ms = DEFAULT_FLUSH_MS
+            _flush_interval_s[0] = max(0.0, ms) / 1e3
+        return _flush_interval_s[0]
+
+
+def _reset_debounce() -> None:
+    """Tests (and ``obs.reset``): forget the interval snapshot and the
+    last-publish stamp so each test arms fresh."""
+    with _flush_lock:
+        _flush_interval_s[0] = None
+        _last_publish[0] = None
 
 
 def _atomic_write(path: str, text: str) -> None:
@@ -79,6 +116,8 @@ def _jsonl_lines(label: str) -> list:
         lines.append(json.dumps({
             "type": "span", "name": s.name, "ts_us": s.t0_us,
             "dur_us": s.dur_us, "tid": s.tid, "depth": s.depth,
+            **({"trace": s.trace} if s.trace else {}),
+            **({"track": s.track} if s.track else {}),
             **({"attrs": dict(s.attrs)} if s.attrs else {}),
         }))
     lines.append(json.dumps({"type": "metrics", **_metrics.snapshot()}))
@@ -104,20 +143,44 @@ def publish(label: str = "run", directory: str | None = None) -> dict:
     _atomic_write(paths["jsonl"], "\n".join(_jsonl_lines(label)) + "\n")
     _atomic_write(paths["chrome_trace"], json.dumps(_trace.chrome_trace()))
     _atomic_write(paths["prom"], prometheus_text())
+    with _flush_lock:
+        _last_publish[0] = time.monotonic()
     return paths
 
 
-def maybe_publish(label: str = "run") -> dict | None:
+def maybe_publish(label: str = "run", force: bool = False) -> dict | None:
     """:func:`publish` when armed, no-op (None) otherwise — the call the
     instrumented entry points (bench, sweeps, smokes) make
-    unconditionally.  Never raises: a full disk must degrade the
-    telemetry, not the solve."""
+    unconditionally.  Auto-publishes are DEBOUNCED on a monotonic clock
+    (:func:`flush_interval_s`): within the interval of the last publish
+    the call is skipped (counted in ``obs.publish_skipped``) so the
+    constant per-publish file cost amortizes across a hot sweep loop
+    instead of taxing every call.  ``force=True`` bypasses the debounce
+    — phase ends (bench exit, daemon drain, smoke children) always
+    flush a complete final snapshot.  Never raises: a full disk must
+    degrade the telemetry, not the solve.  Also flushes the measured
+    performance ledger (:mod:`raft_tpu.obs.ledger`) on every real
+    publish, so its on-disk entries stay as fresh as the sinks."""
     if not enabled():
         return None
+    if not force:
+        interval = flush_interval_s()
+        with _flush_lock:
+            last = _last_publish[0]
+        if last is not None and time.monotonic() - last < interval:
+            _metrics.counter("obs.publish_skipped").inc()
+            return None
     try:
-        return publish(label)
+        out = publish(label)
     except OSError:  # pragma: no cover - disk full / permissions
         return None
+    try:
+        from raft_tpu.obs import ledger as _ledger
+
+        _ledger.flush()
+    except Exception:  # pragma: no cover - ledger must not fail publish
+        pass
+    return out
 
 
 def read_jsonl(path: str) -> tuple:
@@ -194,6 +257,7 @@ def obs_block() -> dict:
         "counters": snap["counters"],
         "gauges": snap["gauges"],
         "histograms": snap["histograms"],
+        **({"sliding": snap["sliding"]} if "sliding" in snap else {}),
         **({"dropped_names": snap["dropped_names"]}
            if "dropped_names" in snap else {}),
         "compiles": aot.compile_counts(),

@@ -1,0 +1,319 @@
+"""Measured-performance ledger: achieved FLOP/s, bytes/s, and roofline
+fraction per (entry, bucket, topology), persisted next to the AOT cache.
+
+PR 10's budget gate extracts per-executable flops/bytes from AOT
+lowering; the obs layer measures per-bucket dispatch wall time.  This
+module JOINS them: every measured dispatch of a registry-resolved
+executable feeds one ledger entry
+
+    achieved_flops_per_s = flops / best_dispatch_s
+    intensity            = flops / bytes_accessed         (flop/byte)
+    attainable           = min(peak_flops, intensity * peak_bw)
+    roofline_fraction    = achieved_flops_per_s / attainable
+
+and the aggregates are persisted CONTENT-KEYED under the warm-start
+cache root (``<root>/ledger/<entry>-<bucket>-<digest>.json``): the
+digest covers the entry tag, bucket label, device topology, the
+in-repo code fingerprint, and the artifact's own flops/bytes — a source
+edit or a re-lowered program re-keys its measurements instead of
+polluting them, exactly like the AOT executables one directory over.
+This is the measurement substrate ROADMAP item 5's autotuner starts
+from: a tuned knob point must beat THESE numbers, on this topology.
+
+Peak numbers are a small table of per-device-kind assumptions
+(overridable via the ``RAFT_TPU_ROOFLINE`` knob, ``"<flops>:<bytes/s>"``,
+snapshotted once) — each persisted entry records which peak model it
+used (``peak.source``), so a fraction is never mistaken for a
+hardware-verified measurement.  Everything is host-side, bounded, and
+write-atomic (tmp + ``os.replace``, GL202); with the warm-start cache
+disabled the ledger has nowhere durable to live and degrades to a
+no-op at flush time.
+"""
+from __future__ import annotations
+
+import contextlib
+import hashlib
+import json
+import os
+import threading
+
+#: peak (FLOP/s, bytes/s) ASSUMPTIONS by device-kind substring, checked
+#: in order (first match wins).  Sources: published TPU spec sheets
+#: (bf16 peak, HBM bandwidth); the CPU row is a deliberate
+#: order-of-magnitude host default — roofline fractions on CPU compare
+#: runs against each other, not against vendor silicon claims.
+_PEAKS: tuple = (
+    ("v5 lite", (197e12, 819e9)),        # TPU v5e
+    ("v5e", (197e12, 819e9)),
+    ("v5p", (459e12, 2765e9)),
+    ("v4", (275e12, 1228e9)),
+    ("v3", (123e12, 900e9)),
+    ("v2", (45e12, 700e9)),
+    ("cpu", (1e11, 5e10)),
+)
+_DEFAULT_PEAK = (1e11, 5e10)
+
+ROOFLINE_ENV = "RAFT_TPU_ROOFLINE"
+
+_lock = threading.Lock()
+_pending: dict = {}              # digest -> mutable aggregate dict
+_peak_cache: list = [None]       # snapshot-once (arm-time) peak override
+
+
+def _peak_model(device_kind: str) -> dict:
+    """The (peak_flops, peak_bytes_per_s, source) triple for this
+    process: the ``RAFT_TPU_ROOFLINE`` override when set (read ONCE, at
+    first use — the arm-time-snapshot contract), else the built-in
+    assumption table matched on the device kind."""
+    with _lock:
+        if _peak_cache[0] is None:
+            raw = os.environ.get(ROOFLINE_ENV, "").strip()
+            if raw:
+                try:
+                    fs, bs = raw.split(":", 1)
+                    _peak_cache[0] = (float(fs), float(bs), "env")
+                except ValueError:
+                    raise ValueError(
+                        f"{ROOFLINE_ENV}={raw!r} is not "
+                        f"'<peak_flops>:<peak_bytes_per_s>'") from None
+            else:
+                _peak_cache[0] = ()      # sentinel: use the table
+        override = _peak_cache[0]
+    if override:
+        return {"flops_per_s": override[0], "bytes_per_s": override[1],
+                "source": override[2]}
+    kind = (device_kind or "").lower()
+    for sub, (pf, pb) in _PEAKS:
+        if sub in kind:
+            return {"flops_per_s": pf, "bytes_per_s": pb,
+                    "source": f"builtin:{sub}"}
+    return {"flops_per_s": _DEFAULT_PEAK[0],
+            "bytes_per_s": _DEFAULT_PEAK[1], "source": "builtin:default"}
+
+
+def _reset_peak_cache() -> None:
+    """Tests only: forget the snapshot so the next use re-reads env."""
+    with _lock:
+        _peak_cache[0] = None
+
+
+def record(entry: str, bucket: str, compiled, dt_s: float) -> dict | None:
+    """Feed one measured dispatch: ``compiled`` is the resolved
+    executable the dispatch ran (a plain jitted function — cache off —
+    contributes nothing: without the registry there is no artifact
+    identity to key by), ``dt_s`` its wall time through
+    materialization.  Aggregates in memory; :func:`flush` persists.
+    Returns the in-memory aggregate, or None when unmeasurable."""
+    from raft_tpu.cache import aot
+
+    if not (dt_s > 0.0):
+        return None
+    cost = aot.artifact_cost(compiled)
+    if not cost or not cost.get("flops") or not cost.get("bytes_accessed"):
+        return None
+    from raft_tpu.cache import config
+
+    topo = aot._topology()
+    digest = hashlib.sha256(repr(
+        ("ledger", entry, bucket, topo, config.code_fingerprint(),
+         cost.get("flops"), cost.get("bytes_accessed"))
+    ).encode()).hexdigest()[:16]
+    with _lock:
+        agg = _pending.get(digest)
+        if agg is None:
+            agg = _pending[digest] = {
+                "entry": entry, "bucket": bucket,
+                "topology": [str(t) for t in topo],
+                "device_kind": str(topo[1]) if len(topo) > 1 else "?",
+                "flops": float(cost["flops"]),
+                "bytes_accessed": float(cost["bytes_accessed"]),
+                **({"peak_bytes": int(cost["peak_bytes"])}
+                   if "peak_bytes" in cost else {}),
+                "count": 0, "total_s": 0.0, "best_s": float("inf"),
+            }
+        agg["count"] += 1
+        agg["total_s"] += float(dt_s)
+        agg["best_s"] = min(agg["best_s"], float(dt_s))
+        return dict(agg)
+
+
+def _derived(agg: dict) -> dict:
+    """The persisted form of one aggregate: raw accounting plus the
+    achieved/roofline numbers (computed from ``best_s`` — the cleanest
+    observation of the hardware; the mean is reported beside it)."""
+    out = dict(agg)
+    best = out["best_s"]
+    out["mean_s"] = round(out["total_s"] / max(1, out["count"]), 9)
+    out["best_s"] = round(best, 9)
+    out["total_s"] = round(out["total_s"], 9)
+    peak = _peak_model(out.get("device_kind", ""))
+    achieved_f = out["flops"] / best
+    achieved_b = out["bytes_accessed"] / best
+    intensity = (out["flops"] / out["bytes_accessed"]
+                 if out["bytes_accessed"] else 0.0)
+    attainable = min(peak["flops_per_s"], intensity * peak["bytes_per_s"])
+    out.update({
+        "achieved_flops_per_s": float(f"{achieved_f:.6g}"),
+        "achieved_bytes_per_s": float(f"{achieved_b:.6g}"),
+        "intensity_flops_per_byte": float(f"{intensity:.6g}"),
+        "peak": {k: (float(f"{v:.6g}") if isinstance(v, float) else v)
+                 for k, v in peak.items()},
+        "attainable_flops_per_s": float(f"{attainable:.6g}"),
+        "roofline_fraction": (float(f"{achieved_f / attainable:.6g}")
+                              if attainable > 0 else 0.0),
+        "schema": 1,
+    })
+    return out
+
+
+def root() -> str | None:
+    """The ledger directory (``<cache root>/ledger``), or None when the
+    warm-start cache is disabled (no durable home next to the AOT
+    artifacts means nothing to persist)."""
+    from raft_tpu.cache import config
+
+    try:
+        return config.subdir("ledger")
+    except config.CacheDisabledError:
+        return None
+
+
+@contextlib.contextmanager
+def _merge_lock(d: str):
+    """Advisory cross-process lock around the read-merge-write cycle:
+    two armed processes sharing one cache root (a daemon and a bench,
+    two daemons) must not lose each other's counts to the classic
+    read-modify-write race — ``os.replace`` makes each WRITE atomic,
+    but only the flock makes the MERGE atomic.  Best-effort: where
+    flock is unavailable the flush still runs, merely unserialized."""
+    path = os.path.join(d, ".merge.lock")
+    try:
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o644)
+    except OSError:                  # pragma: no cover - perms
+        yield
+        return
+    try:
+        try:
+            import fcntl
+
+            fcntl.flock(fd, fcntl.LOCK_EX)
+        except (ImportError, OSError):  # pragma: no cover - non-posix
+            pass
+        yield
+    finally:
+        os.close(fd)                 # closing releases the flock
+
+
+def flush() -> list:
+    """Persist every pending aggregate, merging with what is already on
+    disk for the same digest (count/total sum, best min — a restarted
+    daemon keeps improving the same entry instead of forking it).
+    Atomic per file, and the read-merge-write cycle is serialized
+    across processes by an advisory flock; returns the paths written
+    ([] when the cache is off or nothing is pending).  Pending
+    aggregates are consumed."""
+    d = root()
+    if d is None:
+        return []
+    with _lock:
+        batch = dict(_pending)
+        _pending.clear()
+    if not batch:
+        return []
+    from raft_tpu.obs import export
+
+    with _merge_lock(d):
+        return _flush_batch(d, batch, export)
+
+
+def _flush_batch(d: str, batch: dict, export) -> list:
+    paths = []
+    for digest, agg in sorted(batch.items()):
+        path = os.path.join(
+            d, f"{agg['entry']}-{agg['bucket']}-{digest}.json")
+        prev = None
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                prev = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            prev = None              # absent or corrupt: start fresh
+        if isinstance(prev, dict) and prev.get("count"):
+            agg = dict(agg)
+            agg["count"] += int(prev.get("count", 0))
+            agg["total_s"] += float(prev.get("total_s", 0.0))
+            agg["best_s"] = min(agg["best_s"],
+                                float(prev.get("best_s", float("inf"))))
+        try:
+            export._atomic_write(path, json.dumps(_derived(agg), indent=1,
+                                                  sort_keys=True) + "\n")
+        except OSError:              # pragma: no cover - disk full/perms
+            continue
+        paths.append(path)
+    return paths
+
+
+def entries() -> list:
+    """Every persisted ledger entry (corruption-tolerant: undecodable
+    files are skipped — the ChunkStore rule), sorted by (entry,
+    bucket)."""
+    d = root()
+    if d is None or not os.path.isdir(d):
+        return []
+    out = []
+    for fname in sorted(os.listdir(d)):
+        if not fname.endswith(".json"):
+            continue
+        try:
+            with open(os.path.join(d, fname), "r", encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        if isinstance(rec, dict):
+            rec["file"] = fname
+            out.append(rec)
+    return sorted(out, key=lambda r: (r.get("entry", ""),
+                                      r.get("bucket", "")))
+
+
+def stat() -> dict:
+    """Lightweight ledger status — directory, unflushed aggregate
+    count, persisted file count — WITHOUT reading any file contents.
+    This is what a polled control op (the daemon's ``stats``) embeds:
+    a monitoring client hitting it every few seconds must not make the
+    server re-parse every ledger entry per poll (use :func:`entries`
+    for the full records)."""
+    d = root()
+    n = 0
+    if d is not None and os.path.isdir(d):
+        n = sum(1 for f in sorted(os.listdir(d)) if f.endswith(".json"))
+    with _lock:
+        pending = len(_pending)
+    return {"dir": d, "pending": pending, "n_entries": n}
+
+
+def summary() -> dict:
+    """The ``stats``-op / bench-block form: where the ledger lives, how
+    many aggregates are unflushed, and the persisted entries' headline
+    numbers."""
+    d = root()
+    with _lock:
+        pending = len(_pending)
+    ents = entries()
+    return {
+        "dir": d,
+        "pending": pending,
+        "n_entries": len(ents),
+        "entries": [{
+            "entry": e.get("entry"), "bucket": e.get("bucket"),
+            "count": e.get("count"),
+            "best_s": e.get("best_s"),
+            "achieved_flops_per_s": e.get("achieved_flops_per_s"),
+            "roofline_fraction": e.get("roofline_fraction"),
+        } for e in ents],
+    }
+
+
+def reset() -> None:
+    """Drop unflushed aggregates (tests, phase boundaries)."""
+    with _lock:
+        _pending.clear()

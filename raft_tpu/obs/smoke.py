@@ -82,7 +82,10 @@ def _child(argv) -> None:
 
     nw_phys = next(iter(staged.values())).nw
     solves = len(fnames) * nw_phys
-    published = obs.maybe_publish("smoke")
+    # forced: the per-sweep auto-publishes above are debounced
+    # (RAFT_TPU_OBS_FLUSH_MS), and the child's final snapshot must
+    # always be complete
+    published = obs.maybe_publish("smoke", force=True)
     print(json.dumps({
         "armed": obs.enabled(),
         "n_designs": len(fnames),
@@ -132,14 +135,26 @@ def _validate_chrome_trace(path: str) -> dict:
     """
     with open(path, "r", encoding="utf-8") as f:
         doc = json.load(f)
-    events = doc.get("traceEvents")
-    assert isinstance(events, list) and events, "traceEvents missing/empty"
+    all_events = doc.get("traceEvents")
+    assert isinstance(all_events, list) and all_events, \
+        "traceEvents missing/empty"
+    # metadata events ("ph": "M" — thread names) carry no ts/dur and are
+    # exempt from the complete-event schema and the nesting walk
+    meta = [ev for ev in all_events if ev.get("ph") == "M"]
+    events = [ev for ev in all_events if ev.get("ph") != "M"]
+    for ev in meta:
+        assert ev.get("name") == "thread_name" and "name" in ev.get(
+            "args", {}), f"malformed metadata event: {ev}"
     for ev in events:
         for field in ("name", "ph", "ts", "dur", "pid", "tid"):
             assert field in ev, f"event missing {field!r}: {ev}"
         assert ev["ph"] == "X", f"unexpected phase {ev['ph']!r}"
         for field in ("ts", "dur", "pid", "tid"):
             assert isinstance(ev[field], int), f"non-integer {field}"
+    # every track with complete events is named by a metadata event
+    named = {ev["tid"] for ev in meta}
+    assert {ev["tid"] for ev in events} <= named, \
+        "track missing its thread_name metadata event"
     bad_nesting = 0
     by_tid: dict = {}
     for ev in events:

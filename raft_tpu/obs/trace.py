@@ -19,16 +19,36 @@ exact long after the ring has wrapped, and a long-lived process can
 never grow either without limit.
 
 Exporters: :func:`chrome_trace` renders the ring as Chrome trace-event
-JSON (complete ``"ph": "X"`` events; load the file in Perfetto or
-``chrome://tracing`` — children nest by time containment per thread
-track), and :func:`rollup` is the machine-readable per-name summary the
-bench JSON embeds.  All host-side: a span can never change a traced
-program, an AOT key, or a compiled artifact.
+JSON (complete ``"ph": "X"`` events plus one ``thread_name`` metadata
+event per track; load the file in Perfetto or ``chrome://tracing`` —
+children nest by time containment per thread track), and
+:func:`rollup` is the machine-readable per-name summary the bench JSON
+embeds.  All host-side: a span can never change a traced program, an
+AOT key, or a compiled artifact.
+
+**Trace context (request-scoped tracing).**  A span tree that follows
+one *request* crosses threads: the client submits on one, a connection
+reader stages on another, the solver loop dispatches on a third.  Three
+primitives stitch those fragments into ONE tree:
+
+* :func:`new_trace_id` mints a process-unique request id (pid +
+  counter — deterministic, no wall-clock or randomness);
+* :func:`current_context` captures this thread's ``(trace id, open
+  path)`` as a :class:`TraceContext` token, and ``with context(tok):``
+  adopts it on ANY thread — spans opened inside carry the token's trace
+  id and nest under its path;
+* :func:`record` accepts explicit ``trace``/``tid``/``track`` overrides
+  so a coordinator thread (the serve solver loop) can emit spans for
+  stages it timed on behalf of a request — e.g. queue wait — onto a
+  stable synthetic track (:func:`synthetic_tid`), keeping per-track
+  time containment intact even when many requests overlap in time.
 """
 from __future__ import annotations
 
 import contextlib
 import dataclasses
+import hashlib
+import itertools
 import os
 import threading
 import time
@@ -44,16 +64,25 @@ _OVERFLOW = "<other>"
 #: process trace epoch — every span timestamp is µs after this instant
 _EPOCH_NS = time.perf_counter_ns()
 
+#: tid -> thread name, captured at record time for the Chrome metadata
+#: events; bounded like every other buffer (FIFO eviction past the cap)
+_TID_NAMES_MAX = 4096
+
 _lock = threading.Lock()
 _spans: deque = deque(maxlen=_SPANS_MAX)
 _agg: dict = {}                  # full name -> [count, total_seconds]
+_tid_names: dict = {}            # tid -> thread name (bounded)
 _tls = threading.local()
+_trace_ids = itertools.count(1)  # lock-free unique suffix per process
 
 
 @dataclasses.dataclass(frozen=True)
 class Span:
     """One completed span: full nested ``name``, start/duration in µs
-    relative to the process trace epoch, and the recording thread."""
+    relative to the process trace epoch, and the recording thread.
+    ``trace`` groups the spans of one request across threads (empty
+    outside any trace context); ``track`` optionally names a synthetic
+    Chrome track the span renders on (empty = the recording thread)."""
 
     name: str
     t0_us: int
@@ -61,6 +90,25 @@ class Span:
     tid: int
     depth: int
     attrs: tuple = ()            # ((key, value), ...) — small, hashable
+    trace: str = ""
+    track: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class TraceContext:
+    """A portable handle to an open span tree: the trace id plus the
+    path spans should nest under.  Capture with :func:`current_context`
+    on the owning thread, adopt with :func:`context` on any other."""
+
+    trace: str = ""
+    path: str = ""
+
+
+def new_trace_id() -> str:
+    """Mint a process-unique trace id (pid + counter): deterministic —
+    no randomness, no wall clock — and unique across the processes of
+    one machine, which is all a local request tree needs."""
+    return f"{os.getpid():x}-{next(_trace_ids)}"
 
 
 def _stack() -> list:
@@ -75,11 +123,54 @@ def current_path() -> str:
     return "/".join(_stack())
 
 
+def current_trace() -> str:
+    """The trace id adopted on THIS thread ("" outside any context)."""
+    return getattr(_tls, "trace", "") or ""
+
+
+def current_context() -> TraceContext:
+    """This thread's trace id + open span path as a portable token."""
+    return TraceContext(trace=current_trace(), path=current_path())
+
+
+@contextlib.contextmanager
+def context(ctx: TraceContext):
+    """Adopt another thread's trace context: spans opened inside nest
+    under ``ctx.path`` and carry ``ctx.trace``.  The thread's previous
+    context (stack and trace id) is restored on exit — contexts nest."""
+    old_trace = getattr(_tls, "trace", "")
+    old_stack = getattr(_tls, "stack", None)
+    _tls.trace = ctx.trace
+    _tls.stack = [p for p in ctx.path.split("/") if p] if ctx.path else []
+    try:
+        yield
+    finally:
+        _tls.trace = old_trace
+        _tls.stack = old_stack if old_stack is not None else []
+
+
+def synthetic_tid(key: str) -> int:
+    """A stable 31-bit Chrome track id for ``key`` (a trace id, or
+    ``trace#lane``): the serve loop renders request-scoped stages on
+    per-request tracks so overlapping requests never break per-track
+    time containment.  Deterministic across processes."""
+    return int.from_bytes(hashlib.blake2s(key.encode(),
+                                          digest_size=4).digest(),
+                          "big") & 0x7FFFFFFF
+
+
 def record(full: str, t0_ns: int, t1_ns: int, depth: int = 0,
-           attrs: dict | None = None) -> None:
+           attrs: dict | None = None, trace: str | None = None,
+           tid: int | None = None, track: str | None = None) -> None:
     """Record one completed span from explicit monotonic-ns endpoints
     (the :func:`span` context manager's backend; callers that already
-    timed a region feed it here rather than timing twice)."""
+    timed a region feed it here rather than timing twice).
+
+    ``trace`` defaults to the recording thread's adopted trace id;
+    ``tid`` defaults to the recording thread (pass
+    :func:`synthetic_tid` output to place the span on a synthetic
+    track, naming it via ``track``) — the serve loop uses both to emit
+    request-scoped stages it timed on other threads' behalf."""
     # µs endpoints are BOTH floored against the epoch and the duration is
     # their difference — never an independently-floored (t1-t0).  Floor is
     # monotonic, so a child interval inside its parent's ns interval stays
@@ -88,17 +179,26 @@ def record(full: str, t0_ns: int, t1_ns: int, depth: int = 0,
     # by sub-µs rounding.
     t0_us = max(0, (t0_ns - _EPOCH_NS) // 1000)
     end_us = max(t0_us, (t1_ns - _EPOCH_NS) // 1000)
+    real_tid = tid is None
+    if real_tid:
+        tid = threading.get_ident() & 0x7FFFFFFF
     s = Span(
         name=full,
         t0_us=t0_us,
         dur_us=end_us - t0_us,
-        tid=threading.get_ident() & 0x7FFFFFFF,
+        tid=tid,
         depth=depth,
         attrs=tuple(sorted(attrs.items())) if attrs else (),
+        trace=current_trace() if trace is None else trace,
+        track=track or "",
     )
     dt_s = max(0, t1_ns - t0_ns) / 1e9
     with _lock:
         _spans.append(s)
+        if real_tid and tid not in _tid_names:
+            if len(_tid_names) >= _TID_NAMES_MAX:  # pragma: no cover
+                _tid_names.pop(next(iter(_tid_names)))
+            _tid_names[tid] = threading.current_thread().name
         key = full if (full in _agg or len(_agg) < _AGG_MAX) else _OVERFLOW
         c = _agg.get(key)
         if c is None:
@@ -158,12 +258,24 @@ def rollup() -> dict:
 
 def chrome_trace() -> dict:
     """The span ring as a Chrome trace-event JSON object (Perfetto /
-    ``chrome://tracing`` loadable).  Complete events (``"ph": "X"``) with
-    µs timestamps; one track per recording thread; the full nested path
-    rides in ``args.path`` while the event name is the leaf."""
+    ``chrome://tracing`` loadable).  Complete events (``"ph": "X"``)
+    with µs timestamps; one track per recording thread (or synthetic
+    request track); the full nested path rides in ``args.path``, the
+    request trace id in ``args.trace``, and the event name is the leaf.
+    One ``thread_name`` metadata event (``"ph": "M"``) labels every
+    track — real threads by their Python thread name, synthetic tracks
+    by the recording span's ``track`` string."""
     pid = os.getpid()
+    with _lock:
+        ring = list(_spans)
+        names = dict(_tid_names)
+    track_names: dict = {}
     events = []
-    for s in spans():
+    for s in ring:
+        if s.track:
+            track_names[s.tid] = s.track
+        elif s.tid not in track_names:
+            track_names[s.tid] = names.get(s.tid, f"thread-{s.tid}")
         events.append({
             "name": s.name.rsplit("/", 1)[-1],
             "cat": "raft_tpu",
@@ -172,15 +284,23 @@ def chrome_trace() -> dict:
             "dur": s.dur_us,
             "pid": pid,
             "tid": s.tid,
-            "args": {"path": s.name, **dict(s.attrs)},
+            "args": {"path": s.name,
+                     **({"trace": s.trace} if s.trace else {}),
+                     **dict(s.attrs)},
         })
-    return {"traceEvents": events, "displayTimeUnit": "ms"}
+    meta = [{
+        "name": "thread_name", "ph": "M", "pid": pid, "tid": tid,
+        "args": {"name": name},
+    } for tid, name in sorted(track_names.items())]
+    return {"traceEvents": meta + events, "displayTimeUnit": "ms"}
 
 
 def reset() -> None:
-    """Clear the span ring and the roll-up aggregates (tests, phase
-    boundaries of long-lived processes).  Open spans on any thread keep
-    their stacks — only completed-span history is dropped."""
+    """Clear the span ring, the roll-up aggregates, and the track-name
+    table (tests, phase boundaries of long-lived processes).  Open
+    spans on any thread keep their stacks — only completed-span history
+    is dropped."""
     with _lock:
         _spans.clear()
         _agg.clear()
+        _tid_names.clear()

@@ -1276,6 +1276,13 @@ def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
             t0 = time.perf_counter()
             outs = jax.block_until_ready(fn(*args))
             dispatch_s = time.perf_counter() - t0
+        # performance ledger: join this measured dispatch with the
+        # executable's own flops/bytes accounting (no-op when the cache
+        # is off — a plain jitted fn has no artifact identity).  The
+        # chunked path is excluded: its wall time spans a pipeline of
+        # dispatches, not one executable run.
+        _obs.ledger.record("sweep_designs", _sig_label(batch.sig), fn,
+                           dispatch_s)
     _record_bucket_metrics(_obs, batch, B, dispatch_s)
     out0, iters = outs[:2]
     if return_xi:

@@ -48,6 +48,8 @@ class Lane:
     label: str                # short design label (metrics/logs)
     staged: object            # (design, members, rna, env, wave, C_moor)
     t_submit: float = 0.0     # batcher clock reading at submit
+    trace: str = ""           # request-scoped trace id (obs.trace)
+    t_submit_ns: int = 0      # perf_counter_ns at submit (span endpoints)
 
 
 class MicroBatcher:

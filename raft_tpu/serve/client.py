@@ -7,6 +7,15 @@ generator submits at its schedule regardless of completions), ``call``
 is the synchronous convenience wrapper.  Responses arrive in whatever
 order the server's batches close — the reader resolves each future by
 the ``id`` echoed in the response frame.
+
+Request tracing: every solve-kind submit carries a ``trace`` id (minted
+here via :func:`raft_tpu.obs.trace.new_trace_id` unless the caller set
+one), and the client records a ``request`` span — submit to response —
+under that id on the request's synthetic track when the response lands.
+In-process (the bench's embedded daemon, the tests) that client span is
+the ROOT of the same tree the server's ``request/server`` spans nest
+under; cross-process each side exports its own half, joined by the
+shared trace id.
 """
 from __future__ import annotations
 
@@ -17,6 +26,8 @@ import time
 from concurrent.futures import Future
 
 from raft_tpu.serve import protocol
+
+_TRACED_OPS = ("solve", "dlc", "sweep")
 
 
 class ServerGone(ConnectionError):
@@ -62,8 +73,21 @@ class SolveClient:
                 obj = protocol.recv_msg(self._sock)
                 rid = obj.get("id") if isinstance(obj, dict) else None
                 with self._flock:
-                    fut = self._futures.pop(rid, None)
-                if fut is not None:
+                    entry = self._futures.pop(rid, None)
+                if entry is not None:
+                    fut, t_submit_ns, trace_id = entry
+                    if trace_id:
+                        # the client half of the request tree: submit ->
+                        # response, on the request's synthetic track (the
+                        # reader thread serves MANY overlapping requests —
+                        # recording there would break track containment)
+                        from raft_tpu.obs import trace as _trace
+
+                        _trace.record(
+                            "request", t_submit_ns, time.perf_counter_ns(),
+                            trace=trace_id,
+                            tid=_trace.synthetic_tid(trace_id),
+                            track=f"req {rid}")
                     fut.set_result(obj)
                 # responses for unknown ids (e.g. a server-side error
                 # frame with id=None) are dropped — nothing waits on them
@@ -71,21 +95,29 @@ class SolveClient:
             if not self._closed:
                 err = e if isinstance(e, Exception) else err
         with self._flock:
-            pending = list(self._futures.values())
+            pending = [entry[0] for entry in self._futures.values()]
             self._futures.clear()
         for fut in pending:
             fut.set_exception(ServerGone(str(err)))
 
     def submit(self, obj: dict) -> Future:
         """Send one request frame; returns the Future of its response.
-        Assigns a fresh ``id`` unless the caller set one."""
+        Assigns a fresh ``id`` (and, for solve-kind ops, a fresh
+        ``trace`` id) unless the caller set them."""
         if "id" not in obj or obj["id"] is None:
             obj = {**obj, "id": f"c{next(self._ids)}"}
+        trace_id = obj.get("trace")
+        if trace_id is None and obj.get("op") in _TRACED_OPS:
+            from raft_tpu.obs import trace as _trace
+
+            trace_id = _trace.new_trace_id()
+            obj = {**obj, "trace": trace_id}
         fut: Future = Future()
         with self._flock:
             if self._closed:
                 raise ConnectionError("client is closed")
-            self._futures[obj["id"]] = fut
+            self._futures[obj["id"]] = (fut, time.perf_counter_ns(),
+                                        trace_id or "")
         try:
             with self._wlock:
                 protocol.send_msg(self._sock, obj)

@@ -22,12 +22,37 @@ Request schema (``op`` selects the kind)::
 like ``"OC3spar"``), an absolute YAML path, or an inline design dict
 (the :func:`raft_tpu.model.load_design` passthrough).
 
+Any solve-kind request may additionally carry a ``"trace"`` string: the
+request-scoped trace id every span of its life is recorded under
+(client submit, reader parse/stage, queue wait, batch solve, delivery).
+The client mints one per request when the caller didn't
+(:func:`raft_tpu.obs.trace.new_trace_id`); the server adopts it — so a
+Perfetto trace exported on either side groups one request's spans
+across processes AND threads by the same id.
+
 Response: ``{"id": ..., "ok": true, "results": [<per-lane dict>, ...],
-"health": {...}, "t_queue_s": [...], "server": {...}}`` with one result
-row per requested lane, in request order — a multi-lane request
-(``dlc``/``sweep``) answers once, after its last lane's batch lands.
-Errors: ``{"id": ..., "ok": false, "error": {"class": ..., "detail":
-...}}``.
+"health": {...}, "t_queue_s": [...], "trace": ..., "server": {...}}``
+with one result row per requested lane, in request order — a multi-lane
+request (``dlc``/``sweep``) answers once, after its last lane's batch
+lands.  Errors: ``{"id": ..., "ok": false, "error": {"class": ...,
+"detail": ...}}``.
+
+The ``stats`` op answers with the live telemetry snapshot::
+
+    {"id": ..., "ok": true, "op": "stats",
+     "solver": {...},                  # per-bucket batches/occupancy,
+                                       # compiles, arm-time knobs
+     "queue": {...}, "queue_depth": {...},
+     "telemetry": {
+        "uptime_s": ..., "window_s": ...,
+        "latency": {count, p50, p90, p99, errors, error_rate, ...},
+        "queue_wait": {"<SxNxW>": {...same windowed shape...}, ...},
+        "error_budget": {"requests", "errors", "error_rate"},
+        "flight": {"capacity", "size", "recorded", "errors"},
+        "compiles": ..., "ledger": {...}}}
+
+(the windowed quantiles are deterministic rank-walk values over the
+sliding sub-window ring — see ``docs/observability.rst``).
 """
 from __future__ import annotations
 
@@ -145,7 +170,12 @@ def parse_request(obj) -> dict:
     op = obj.get("op")
     if op not in OPS:
         raise ProtocolError(f"unknown op {op!r}; have {OPS}")
-    out = {"op": op, "id": obj.get("id"), "lanes": []}
+    tr = obj.get("trace")
+    if tr is not None and not isinstance(tr, str):
+        raise ProtocolError(f"'trace' must be a string; got "
+                            f"{type(tr).__name__}")
+    out = {"op": op, "id": obj.get("id"), "lanes": [],
+           "trace": tr or None}
     if op in ("ping", "stats", "refresh", "shutdown"):
         return out
     if out["id"] is None:

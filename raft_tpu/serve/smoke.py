@@ -14,13 +14,26 @@ warm-start cache root and proves, over the real socket:
 * SIGTERM is graceful (rc 0, socket unlinked), and a WARM RESTART on the
   same cache root reaches ready-to-serve with ZERO compiles (every
   bucket an AOT disk hit), in strictly less time than the cold start,
-  and serves the same stream bit-identically.
+  and serves the same stream bit-identically;
+* the warm restart runs with ``RAFT_TPU_OBS`` ARMED (the cold daemon
+  runs unarmed) and proves the request-scoped observability layer
+  cross-process: the exported JSONL is zero-corrupt and carries ONE
+  complete span tree per served request (``request/server`` +
+  ``stage``/``queue_wait``/``solve`` under one trace id), the daemon's
+  ``stats`` op returns windowed p50/p99 consistent with the
+  client-observed latencies, SIGTERM leaves a populated flight-recorder
+  dump, a content-keyed ledger entry with finite achieved-FLOP/s and
+  roofline fraction exists for EVERY warm bucket, and the armed
+  stream's wall time stays within the 2x overhead guard of the unarmed
+  one.
 
 Prints one JSON line; rc 0 iff all checks hold.
 """
 from __future__ import annotations
 
+import glob
 import json
+import math
 import os
 import signal
 import subprocess
@@ -39,7 +52,7 @@ BATCH_MAX = 4
 DEADLINE_MS = 40.0
 
 
-def _child_env(cache_dir: str) -> dict:
+def _child_env(cache_dir: str, obs_dir: str | None = None) -> dict:
     env = dict(os.environ)
     env["RAFT_TPU_CACHE_DIR"] = cache_dir
     env["JAX_PLATFORMS"] = "cpu"
@@ -50,6 +63,11 @@ def _child_env(cache_dir: str) -> dict:
     env.pop("RAFT_TPU_BUCKETS", None)
     env.pop("RAFT_TPU_SERVE_BATCH_DEADLINE_MS", None)
     env.pop("RAFT_TPU_SERVE_BATCH_MAX", None)
+    env.pop("RAFT_TPU_OBS_FLUSH_MS", None)
+    if obs_dir is None:
+        env.pop("RAFT_TPU_OBS", None)
+    else:
+        env["RAFT_TPU_OBS"] = obs_dir
     return env
 
 
@@ -83,7 +101,8 @@ def _read_ready_line(proc, timeout_s: float) -> str:
         f"daemon died before ready (rc={proc.wait(10.0)})")
 
 
-def _spawn_daemon(cache_dir: str, sock: str, stderr_path: str):
+def _spawn_daemon(cache_dir: str, sock: str, stderr_path: str,
+                  obs_dir: str | None = None):
     # a DAEMON child is unbounded by design: its lifetime is managed
     # explicitly (threaded ready-line deadline in _read_ready_line,
     # SIGTERM + bounded wait in _stop_daemon, kill on timeout) rather
@@ -97,7 +116,7 @@ def _spawn_daemon(cache_dir: str, sock: str, stderr_path: str):
          "--deadline-ms", str(DEADLINE_MS), "--batch-max", str(BATCH_MAX),
          "--warm", "oc3,oc4,volturnus"],
         stdout=subprocess.PIPE, stderr=stderr_f, text=True,
-        env=_child_env(cache_dir),
+        env=_child_env(cache_dir, obs_dir),
         cwd=os.path.dirname(os.path.dirname(os.path.dirname(
             os.path.abspath(__file__)))),
     )
@@ -121,20 +140,32 @@ def _spawn_daemon(cache_dir: str, sock: str, stderr_path: str):
 
 def _drive_stream(sock: str):
     """Submit the whole mixed stream open-loop, collect responses + final
-    server stats; returns (per-request std_dev rows, stats)."""
+    server stats; returns ``(per-request std_dev rows, full stats
+    response, drive info)`` where the info dict carries the stream wall
+    time, the per-request client-side latencies, and every response's
+    trace id (the server-side span trees are checked against them)."""
     from raft_tpu.serve.client import SolveClient
 
     with SolveClient(sock, connect_timeout=30.0) as cl:
-        futs = [cl.submit({"op": "solve", "design": d, "Hs": Hs, "Tp": Tp})
-                for d, Hs, Tp in STREAM]
-        rows = []
-        for f in futs:
+        t0 = time.perf_counter()
+        submit_t = []
+        futs = []
+        for d, Hs, Tp in STREAM:
+            submit_t.append(time.perf_counter())
+            futs.append(cl.submit({"op": "solve", "design": d,
+                                   "Hs": Hs, "Tp": Tp}))
+        rows, traces, lat = [], [], []
+        for i, f in enumerate(futs):
             r = f.result(120.0)
+            lat.append(time.perf_counter() - submit_t[i])
             if not r.get("ok"):
                 raise RuntimeError(f"request failed: {r.get('error')}")
             rows.append(r["results"][0]["std_dev"])
-        stats = cl.stats()["solver"]
-    return rows, stats
+            traces.append(r.get("trace"))
+        wall_s = time.perf_counter() - t0
+        stats = cl.stats()
+    info = {"wall_s": wall_s, "latencies_s": lat, "traces": traces}
+    return rows, stats, info
 
 
 def _stop_daemon(proc) -> int:
@@ -173,25 +204,113 @@ def _solo_reference(cache_dir: str):
     return rows, cache.compile_count("sweep_designs")
 
 
+def _check_obs_leg(obs_dir: str, cache_dir: str, traces, info, stats):
+    """The armed warm daemon's observability proof: zero-corrupt JSONL
+    with one complete per-request span tree per served request, a
+    populated flight-recorder dump from the SIGTERM path, finite
+    ledger rooflines for every warm bucket, and windowed stats p50/p99
+    consistent with the client-observed latencies."""
+    from raft_tpu.obs.export import read_jsonl
+
+    out: dict = {}
+    # -- JSONL event log (published by the daemon's post-drain flush) --
+    logs = sorted(glob.glob(os.path.join(obs_dir, "obs-serve-*.jsonl")))
+    out["armed_jsonl_published"] = bool(logs)
+    spans_by_trace: dict = {}
+    corrupt = 0
+    for path in logs:
+        events, bad = read_jsonl(path)
+        corrupt += bad
+        for ev in events:
+            if ev.get("type") == "span" and ev.get("trace"):
+                spans_by_trace.setdefault(ev["trace"], set()).add(
+                    ev["name"])
+    out["armed_jsonl_zero_corrupt"] = bool(logs) and corrupt == 0
+    # -- one COMPLETE span tree per served request --
+    need = {"request/server", "request/server/stage",
+            "request/server/queue_wait", "request/server/solve"}
+    trees = sum(1 for t in traces
+                if t and need <= spans_by_trace.get(t, set()))
+    out["per_request_span_trees"] = trees == len(traces) != 0
+    out["span_trees_complete"] = trees
+    # -- flight recorder dumped on SIGTERM --
+    dumps = sorted(glob.glob(os.path.join(obs_dir, "flight-serve-*.jsonl")))
+    flight_reqs = 0
+    if dumps:
+        events, bad = read_jsonl(dumps[-1])
+        corrupt += bad
+        flight_reqs = sum(1 for ev in events
+                          if ev.get("type") == "request"
+                          and ev.get("outcome") == "ok")
+    out["flight_dump_on_sigterm"] = flight_reqs >= len(traces)
+    out["flight_requests"] = flight_reqs
+    # -- ledger: finite roofline per warm bucket --
+    led_dir = os.path.join(cache_dir, "ledger")
+    buckets_seen = set(stats["solver"]["buckets"])
+    led_buckets: dict = {}
+    for path in glob.glob(os.path.join(led_dir, "*.json")):
+        try:
+            with open(path, "r", encoding="utf-8") as f:
+                rec = json.load(f)
+        except (OSError, json.JSONDecodeError):
+            continue
+        frac = rec.get("roofline_fraction")
+        if (rec.get("entry") == "sweep_designs"
+                and isinstance(frac, float) and math.isfinite(frac)
+                and frac > 0 and math.isfinite(
+                    rec.get("achieved_flops_per_s", float("nan")))):
+            led_buckets[rec.get("bucket")] = frac
+    out["ledger_rooflines_all_buckets"] = (
+        len(buckets_seen) >= 1
+        and {f"{s}" for s in led_buckets} >= {
+            b.strip("()").replace(", ", "x") for b in buckets_seen})
+    out["ledger_rooflines"] = led_buckets
+    # -- windowed SLO vs the client-observed latencies --
+    tel = stats.get("telemetry", {})
+    lat = tel.get("latency", {})
+    client_max = max(info["latencies_s"])
+    out["telemetry_window_counts_stream"] = lat.get("count") == len(traces)
+    out["telemetry_quantiles_consistent"] = (
+        0.0 < lat.get("p50", 0.0) <= lat.get("p99", 0.0)
+        # windowed quantiles report a log-bucket UPPER edge (5 buckets
+        # per decade: at most 10^(1/5) ~ 1.585x above the true value),
+        # and the true server-side latency is <= the client-observed
+        # one — so the server p99 can never legitimately exceed the
+        # worst client latency by more than one bucket of quantization
+        and lat.get("p99", 1e9) <= client_max * 1.585 + 0.05
+        and lat.get("error_rate") == 0.0)
+    out["server_window_p50_s"] = lat.get("p50")
+    out["server_window_p99_s"] = lat.get("p99")
+    out["client_max_latency_s"] = round(client_max, 4)
+    out["queue_wait_windows"] = len(tel.get("queue_wait", {}))
+    return out
+
+
 def main(argv=None) -> int:
     t_all = time.perf_counter()
     keep = argv and "--keep" in argv
     tmp = tempfile.mkdtemp(prefix="raft_tpu_serve_smoke_")
     cache_dir = os.path.join(tmp, "cache")
+    obs_dir = os.path.join(tmp, "obs")
     sock1 = os.path.join(tmp, "serve1.sock")
     sock2 = os.path.join(tmp, "serve2.sock")
     try:
-        # ---- cold daemon: compile, serve, graceful SIGTERM ----
+        # ---- cold daemon: compile, serve, graceful SIGTERM (obs OFF:
+        # the unarmed side of the overhead guard) ----
         proc1, ready1 = _spawn_daemon(cache_dir, sock1,
                                       os.path.join(tmp, "daemon1.err"))
-        rows1, stats1 = _drive_stream(sock1)
+        rows1, full1, info1 = _drive_stream(sock1)
+        stats1 = full1["solver"]
         rc1 = _stop_daemon(proc1)
         sock1_gone = not os.path.exists(sock1)
 
-        # ---- warm restart: zero compiles off the AOT disk cache ----
+        # ---- warm restart: zero compiles off the AOT disk cache, with
+        # the observability layer ARMED ----
         proc2, ready2 = _spawn_daemon(cache_dir, sock2,
-                                      os.path.join(tmp, "daemon2.err"))
-        rows2, stats2 = _drive_stream(sock2)
+                                      os.path.join(tmp, "daemon2.err"),
+                                      obs_dir=obs_dir)
+        rows2, full2, info2 = _drive_stream(sock2)
+        stats2 = full2["solver"]
         rc2 = _stop_daemon(proc2)
 
         # ---- in-process solo reference off the same cache root ----
@@ -210,11 +329,22 @@ def main(argv=None) -> int:
                 ready2["ready_s"] < ready1["ready_s"],
             "warm_rc0": rc2 == 0,
             "solo_zero_compiles": solo_compiles == 0,
+            # armed-vs-unarmed throughput guard (the obs-smoke factor):
+            # instrumentation + tracing must never cost the serving
+            # loop real wall time — both streams run on warm executables
+            "armed_within_overhead_guard":
+                info2["wall_s"] <= 2.0 * info1["wall_s"] + 0.5,
         }
+        obs_checks = _check_obs_leg(obs_dir, cache_dir, info2["traces"],
+                                    info2, full2)
+        checks.update({k: v for k, v in obs_checks.items()
+                       if isinstance(v, bool)})
         ok = all(checks.values())
         print(json.dumps({
             "ok": ok,
             **checks,
+            **{k: v for k, v in obs_checks.items()
+               if not isinstance(v, bool)},
             "n_requests": len(STREAM),
             "n_buckets": n_buckets,
             "cold_compiles": stats1["compiles"],
@@ -224,6 +354,8 @@ def main(argv=None) -> int:
             "warm_restart_speedup": (
                 round(ready1["ready_s"] / ready2["ready_s"], 2)
                 if ready2["ready_s"] > 0 else None),
+            "stream_wall_unarmed_s": round(info1["wall_s"], 3),
+            "stream_wall_armed_s": round(info2["wall_s"], 3),
             "bucket_stats_cold": stats1["buckets"],
             "wall_s": round(time.perf_counter() - t_all, 2),
             **({"dir": tmp} if keep else {}),

@@ -19,6 +19,10 @@ os.environ["RAFT_TPU_CACHE_DIR"] = "off"
 # RAFT_TPU_OBS must not make the suite write sink files (tests that
 # exercise the exporters pass explicit tmp directories)
 os.environ.pop("RAFT_TPU_OBS", None)
+# the obs knobs snapshot once per process; a developer override must not
+# skew the debounce/roofline expectations pinned by the suite
+os.environ.pop("RAFT_TPU_OBS_FLUSH_MS", None)
+os.environ.pop("RAFT_TPU_ROOFLINE", None)
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -101,10 +101,12 @@ def test_chrome_trace_schema():
         with trace.span("b"):
             pass
     doc = trace.chrome_trace()
-    evs = doc["traceEvents"]
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    meta = [e for e in doc["traceEvents"] if e["ph"] == "M"]
     assert len(evs) == 2
+    # one thread_name metadata event names the recording track
+    assert len(meta) == 1 and meta[0]["name"] == "thread_name"
     for ev in evs:
-        assert ev["ph"] == "X"
         for field in ("ts", "dur", "pid", "tid"):
             assert isinstance(ev[field], int) and ev[field] >= 0
         assert ev["cat"] == "raft_tpu"
@@ -124,7 +126,8 @@ def test_chrome_trace_nesting_consistent():
             pass
         with trace.span("c2"):
             pass
-    evs = trace.chrome_trace()["traceEvents"]
+    evs = [e for e in trace.chrome_trace()["traceEvents"]
+           if e["ph"] == "X"]
     by = {ev["args"]["path"]: ev for ev in evs}
     p = by["p"]
     for path in ("p/c1", "p/c2"):
@@ -147,6 +150,79 @@ def test_chrome_trace_containment_survives_subus_rounding():
     p, c = by["p"], by["p/c"]
     assert p.t0_us <= c.t0_us
     assert c.t0_us + c.dur_us <= p.t0_us + p.dur_us
+
+
+# ---------------------------------------------- trace context / trees ----
+
+def test_new_trace_id_unique_and_deterministic_shape():
+    ids = [trace.new_trace_id() for _ in range(100)]
+    assert len(set(ids)) == 100
+    assert all(i.startswith(f"{os.getpid():x}-") for i in ids)
+
+
+def test_trace_context_crosses_threads():
+    """The cross-thread span-tree primitive: a context token captured
+    on one thread, adopted on another — spans on BOTH threads share one
+    trace id and nest under one path."""
+    tid = trace.new_trace_id()
+    tok = trace.TraceContext(trace=tid, path="request/server")
+
+    def worker():
+        with trace.context(tok):
+            assert trace.current_trace() == tid
+            assert trace.current_path() == "request/server"
+            with trace.span("stage"):
+                pass
+        # context restored: this thread is traceless again
+        assert trace.current_trace() == ""
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    with trace.context(tok):
+        with trace.span("solve"):
+            pass
+    spans = [s for s in trace.spans() if s.trace == tid]
+    assert {s.name for s in spans} == {"request/server/stage",
+                                       "request/server/solve"}
+    # two different recording threads, one trace id
+    assert len({s.tid for s in spans}) == 2
+
+
+def test_record_explicit_trace_tid_track_and_metadata():
+    """Explicit-endpoint spans on synthetic tracks (the serve solver
+    loop's queue_wait/solve emission): trace id carried, track named by
+    a thread_name metadata event, args.trace exported."""
+    tid = trace.new_trace_id()
+    stid = trace.synthetic_tid(tid + "#0")
+    assert stid == trace.synthetic_tid(tid + "#0")    # stable
+    trace.record("request/server/queue_wait", 1000, 5000, depth=2,
+                 trace=tid, tid=stid, track="req r7 lane 0")
+    with trace.span("plain"):
+        pass
+    doc = trace.chrome_trace()
+    meta = {e["tid"]: e["args"]["name"]
+            for e in doc["traceEvents"] if e["ph"] == "M"}
+    evs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    qw = [e for e in evs if e["name"] == "queue_wait"][0]
+    assert qw["tid"] == stid and qw["args"]["trace"] == tid
+    assert meta[stid] == "req r7 lane 0"
+    # the real thread's track is named after the Python thread
+    plain = [e for e in evs if e["name"] == "plain"][0]
+    assert meta[plain["tid"]] == threading.current_thread().name
+    json.dumps(doc)
+
+
+def test_jsonl_carries_trace_and_track(tmp_path):
+    tid = trace.new_trace_id()
+    trace.record("request/server/solve", 0, 2000, trace=tid,
+                 tid=trace.synthetic_tid(tid), track="req x")
+    paths = export.publish("t", directory=str(tmp_path))
+    events, corrupt = export.read_jsonl(paths["jsonl"])
+    assert corrupt == 0
+    sp = [e for e in events if e.get("type") == "span"
+          and e.get("trace") == tid]
+    assert sp and sp[0]["track"] == "req x"
 
 
 # ----------------------------------------------------------- metrics ----
@@ -229,6 +305,191 @@ def test_snapshot_json_safe():
     json.dumps(metrics.snapshot())                # strict JSON, no Infinity
 
 
+# ------------------------------------------------- sliding SLO windows ----
+
+def test_sliding_histogram_hand_computable_schedule():
+    """The live-SLO determinism pin: a hand-built observation schedule
+    on a virtual clock yields exactly hand-computable windowed
+    quantiles (rank-walk to the bucket upper edge, same rule as the
+    cumulative histogram)."""
+    edges = metrics.Histogram.edges
+    w = metrics.SlidingHistogram("slo", window_s=60.0, n_sub=12)
+    # 10 observations in one sub-window: 5 under edges[10], 4 under
+    # edges[20], 1 under edges[30] — the cumulative-histogram fixture
+    for _ in range(5):
+        w.observe(edges[10] * 0.999, now=1.0)
+    for _ in range(4):
+        w.observe(edges[20] * 0.999, now=2.0)
+    w.observe(edges[30] * 0.999, now=3.0)
+    snap = w.window(now=3.0)
+    assert snap["count"] == 10
+    assert snap["p50"] == pytest.approx(edges[10])
+    assert snap["p90"] == pytest.approx(edges[20])
+    assert snap["p99"] == pytest.approx(edges[30])
+    assert snap["errors"] == 0 and snap["error_rate"] == 0.0
+
+
+def test_sliding_histogram_ages_out_and_error_rate():
+    w = metrics.SlidingHistogram("slo2", window_s=12.0, n_sub=4)
+    w.observe(0.01, now=0.0)       # sub-window 0 (3 s each)
+    w.error(now=4.0)               # sub-window 1
+    w.observe(0.02, now=7.0)       # sub-window 2
+    snap = w.window(now=7.0)
+    assert snap["count"] == 2 and snap["errors"] == 1
+    assert snap["error_rate"] == pytest.approx(1 / 3)
+    # at t=12.5 sub-window 0 has aged out of the 4-slot ring; 1 and 2
+    # are still live
+    snap2 = w.window(now=12.5)
+    assert snap2["count"] == 1 and snap2["errors"] == 1
+    # far future: everything aged out, slots lazily recycled
+    snap3 = w.window(now=1000.0)
+    assert snap3 == metrics.SlidingHistogram("slo3",
+                                             window_s=12.0,
+                                             n_sub=4).window(now=1000.0)
+    # and a fresh observation after the gap starts a clean window
+    w.observe(0.5, now=1000.0)
+    assert w.window(now=1000.0)["count"] == 1
+
+
+def test_sliding_registry_and_snapshot():
+    w = metrics.sliding("serve.lat", window_s=30.0, n_sub=6)
+    assert metrics.sliding("serve.lat") is w
+    with pytest.raises(ValueError, match="already registered"):
+        metrics.counter("serve.lat")
+    w.observe(0.05)
+    snap = metrics.snapshot()
+    assert snap["sliding"]["serve.lat"]["count"] == 1
+    json.dumps(snap)
+
+
+# ----------------------------------------------------- flight recorder ----
+
+def test_flight_recorder_bounded_counts_and_dump(tmp_path):
+    from raft_tpu.obs.flight import FlightRecorder
+
+    fr = FlightRecorder(capacity=4)
+    for i in range(9):
+        fr.record({"id": f"r{i}", "op": "solve",
+                   "outcome": "ok" if i % 3 else "error:RuntimeError"})
+    c = fr.counts()
+    assert c == {"capacity": 4, "size": 4, "recorded": 9, "errors": 3}
+    assert [r["id"] for r in fr.snapshot()] == ["r5", "r6", "r7", "r8"]
+    path = fr.dump(path=str(tmp_path / "fl.jsonl"), reason="test")
+    events, corrupt = export.read_jsonl(path)
+    assert corrupt == 0
+    assert events[0]["type"] == "meta" and events[0]["reason"] == "test"
+    assert events[0]["recorded"] == 9
+    assert [e["id"] for e in events[1:]] == ["r5", "r6", "r7", "r8"]
+    # no tmp droppings (atomic publish)
+    assert not [p for p in os.listdir(tmp_path) if p.endswith(".tmp")]
+
+
+def test_flight_recorder_dump_unarmed_returns_none(monkeypatch):
+    from raft_tpu.obs.flight import FlightRecorder
+
+    monkeypatch.delenv("RAFT_TPU_OBS", raising=False)
+    fr = FlightRecorder()
+    fr.record({"id": "x"})
+    assert fr.dump() is None        # no sink, nowhere durable to land
+
+
+# --------------------------------------------------- performance ledger ----
+
+class _FakeCompiled:
+    """Stands in for a resolved AOT executable: the two compiler
+    accounting calls the ledger joins with measured time."""
+
+    def __init__(self, flops=1.0e9, byts=2.0e8):
+        self._flops, self._bytes = flops, byts
+
+    def cost_analysis(self):
+        return [{"flops": self._flops, "bytes accessed": self._bytes}]
+
+    def memory_analysis(self):
+        return None
+
+
+@pytest.fixture()
+def _ledger_cache(tmp_path):
+    from raft_tpu import cache
+    from raft_tpu.obs import ledger
+
+    cache.enable(str(tmp_path / "c"))
+    ledger.reset()
+    ledger._reset_peak_cache()
+    yield ledger
+    ledger.reset()
+    ledger._reset_peak_cache()
+    cache.disable()
+
+
+def test_ledger_record_flush_merge_and_roofline(_ledger_cache):
+    ledger = _ledger_cache
+    exe = _FakeCompiled()
+    assert ledger.record("sweep_designs", "16x64x32", exe, 0.010)
+    assert ledger.record("sweep_designs", "16x64x32", exe, 0.005)
+    paths = ledger.flush()
+    assert len(paths) == 1 and os.path.exists(paths[0])
+    rec = json.load(open(paths[0]))
+    assert rec["count"] == 2 and rec["best_s"] == 0.005
+    # achieved FLOP/s from the BEST observation: 1e9 / 0.005
+    assert rec["achieved_flops_per_s"] == pytest.approx(2.0e11, rel=1e-3)
+    # roofline: intensity 5 flop/B -> attainable = min(1e11, 5 * 5e10)
+    # = 1e11 -> fraction = 2e11 / 1e11 (synthetic: > 1 is fine, finite)
+    assert math.isfinite(rec["roofline_fraction"])
+    assert rec["peak"]["source"].startswith("builtin:")
+    # a second flush MERGES (count sums, best min) instead of forking
+    ledger.record("sweep_designs", "16x64x32", exe, 0.020)
+    assert ledger.flush() == paths
+    rec2 = json.load(open(paths[0]))
+    assert rec2["count"] == 3 and rec2["best_s"] == 0.005
+    # summary + entries read it back
+    ents = ledger.entries()
+    assert len(ents) == 1 and ents[0]["bucket"] == "16x64x32"
+    assert ledger.summary()["n_entries"] == 1
+    # the lightweight stats-op form parses nothing but agrees on counts
+    assert ledger.stat() == {"dir": ledger.root(), "pending": 0,
+                             "n_entries": 1}
+
+
+def test_ledger_distinct_buckets_distinct_files(_ledger_cache):
+    ledger = _ledger_cache
+    exe = _FakeCompiled()
+    ledger.record("sweep_designs", "16x64x32", exe, 0.01)
+    ledger.record("sweep_designs", "48x128x32", exe, 0.02)
+    assert len(ledger.flush()) == 2
+    assert {e["bucket"] for e in ledger.entries()} == {"16x64x32",
+                                                       "48x128x32"}
+
+
+def test_ledger_noop_without_cache_or_cost():
+    from raft_tpu import cache
+    from raft_tpu.obs import ledger
+
+    cache.disable()
+    # a plain jitted function has no artifact identity: nothing recorded
+    assert ledger.record("t", "b", lambda x: x, 0.01) is None
+    ledger.record("t", "b", _FakeCompiled(), 0.01)
+    # pending exists, but with the cache off there is nowhere durable
+    assert ledger.root() is None and ledger.flush() == []
+    ledger.reset()
+
+
+def test_ledger_peak_env_override(_ledger_cache, monkeypatch):
+    ledger = _ledger_cache
+    monkeypatch.setenv("RAFT_TPU_ROOFLINE", "1e12:1e11")
+    ledger._reset_peak_cache()
+    ledger.record("sweep_designs", "16x64x32", _FakeCompiled(), 0.010)
+    rec = json.load(open(ledger.flush()[0]))
+    assert rec["peak"] == {"flops_per_s": 1e12, "bytes_per_s": 1e11,
+                           "source": "env"}
+    # snapshot-once: a mid-process env change does not reach the model
+    monkeypatch.setenv("RAFT_TPU_ROOFLINE", "5e12:5e11")
+    ledger.record("sweep_designs", "16x64x32", _FakeCompiled(), 0.001)
+    rec2 = json.load(open(ledger.flush()[0]))
+    assert rec2["peak"]["flops_per_s"] == 1e12
+
+
 # --------------------------------------------------------- exporters ----
 
 def test_prometheus_text_cumulative_buckets():
@@ -280,6 +541,30 @@ def test_env_arming_resolves_directory(tmp_path, monkeypatch):
         pass
     paths = export.maybe_publish("armed")
     assert paths and os.path.dirname(paths["jsonl"]) == str(tmp_path / "sink")
+
+
+def test_maybe_publish_debounced(tmp_path, monkeypatch):
+    """Per-sweep auto-publish amortizes: within the monotonic debounce
+    interval a second maybe_publish is skipped (and counted); force and
+    a fresh interval always write.  The knob snapshots once."""
+    monkeypatch.setenv("RAFT_TPU_OBS", str(tmp_path))
+    monkeypatch.setenv("RAFT_TPU_OBS_FLUSH_MS", "60000")
+    export._reset_debounce()
+    assert export.flush_interval_s() == 60.0
+    # snapshot-once: a mid-process env change does not reach the knob
+    monkeypatch.setenv("RAFT_TPU_OBS_FLUSH_MS", "1")
+    assert export.flush_interval_s() == 60.0
+    with trace.span("x"):
+        pass
+    assert export.maybe_publish("deb") is not None      # first: writes
+    assert export.maybe_publish("deb") is None          # debounced
+    assert export.maybe_publish("deb") is None
+    assert metrics.snapshot()["counters"]["obs.publish_skipped"] == 2
+    assert export.maybe_publish("deb", force=True) is not None
+    # obs.reset() clears the stamp: the next auto-publish writes again
+    obs.reset()
+    monkeypatch.setenv("RAFT_TPU_OBS_FLUSH_MS", "60000")
+    assert export.maybe_publish("deb") is not None
 
 
 def test_read_jsonl_tolerates_midwrite_kill(tmp_path):

@@ -91,6 +91,20 @@ def test_parse_request_kinds_and_errors():
             protocol.parse_request(bad)
 
 
+def test_parse_request_trace_passthrough():
+    r = protocol.parse_request(
+        {"op": "solve", "id": "a", "design": "oc3", "Hs": 6, "Tp": 10,
+         "trace": "abc-1"})
+    assert r["trace"] == "abc-1"
+    r2 = protocol.parse_request(
+        {"op": "solve", "id": "a", "design": "oc3", "Hs": 6, "Tp": 10})
+    assert r2["trace"] is None
+    with pytest.raises(protocol.ProtocolError, match="trace"):
+        protocol.parse_request(
+            {"op": "solve", "id": "a", "design": "oc3", "Hs": 6,
+             "Tp": 10, "trace": 7})
+
+
 def test_design_key_dict_content_hash():
     d1 = {"a": 1, "b": [1, 2]}
     d2 = {"b": [1, 2], "a": 1}          # key order must not matter
@@ -432,6 +446,12 @@ def test_partial_batch_failure_poisons_whole_request(server, monkeypatch):
         # the connection survives and healthy buckets still serve
         ok = cl.solve("oc3", 6.0, 10.0, timeout=180.0)
         assert ok["ok"] and ok["results"][0]["converged"]
+    # the poisoned request fed the error budget and the flight recorder
+    assert server.flight.counts()["errors"] >= 1
+    bad = [rec for rec in server.flight.snapshot()
+           if rec["outcome"].startswith("error:")]
+    assert bad and bad[0]["op"] == "sweep"
+    assert server.telemetry()["error_budget"]["errors"] >= 1
 
 
 def test_refresh_rejects_malformed_values(server):
@@ -475,6 +495,144 @@ def test_shutdown_op_drains(tmp_path):
     while os.path.exists(cfg.socket_path) and _time.monotonic() < deadline:
         _time.sleep(0.02)
     assert not os.path.exists(cfg.socket_path)
+
+
+# --------------------------------------------------------------------------
+# request-scoped tracing + live SLO telemetry
+# --------------------------------------------------------------------------
+def test_request_trace_tree_spans_client_reader_solver(server, tmp_path):
+    """THE request-tracing pin: one request's spans — recorded on the
+    client reader thread, the server connection reader, and the solver
+    loop — share ONE trace id, form one path tree, and the exported
+    Chrome trace keeps valid per-track time containment (metadata
+    thread names included)."""
+    import json as _json
+
+    from raft_tpu.obs import trace
+    from raft_tpu.obs.smoke import _validate_chrome_trace
+    from raft_tpu.serve.client import SolveClient
+
+    trace.reset()        # a clean ring: this test validates ITS request
+    with SolveClient(server.socket_path) as cl:
+        r = cl.solve("oc3", 6.0, 10.0, timeout=180.0)
+    assert r["ok"]
+    tid = r["trace"]
+    assert tid
+    spans = [s for s in trace.spans() if s.trace == tid]
+    paths = {s.name for s in spans}
+    # client root + server root + the reader and solver-loop stages
+    assert {"request", "request/server", "request/server/stage",
+            "request/server/queue_wait", "request/server/solve"} <= paths
+    # the tree really CROSSES threads: the reader-side stage span and
+    # the synthetic-track spans record on distinct tids
+    assert len({s.tid for s in spans}) >= 3
+    # tree containment in ns terms: every server-side span lies inside
+    # the client root's interval
+    by = {s.name: s for s in spans}
+    root = by["request"]
+    for name in ("request/server", "request/server/queue_wait",
+                 "request/server/solve"):
+        s = by[name]
+        assert root.t0_us <= s.t0_us
+        assert s.t0_us + s.dur_us <= root.t0_us + root.dur_us
+    # and the full export passes the Perfetto containment validator
+    p = tmp_path / "trace.json"
+    p.write_text(_json.dumps(trace.chrome_trace()))
+    info = _validate_chrome_trace(str(p))
+    assert info["events"] >= 5
+
+
+def test_queue_wait_exact_under_virtual_clock(tmp_path):
+    """Queue wait is EXACTLY batch-close minus submit on the batcher's
+    clock: the response's t_queue_s, the flight-recorder breakdown, and
+    the windowed SLO quantiles are all hand-computable from a virtual
+    schedule."""
+    from raft_tpu.obs import metrics
+    from raft_tpu.serve import server as server_mod
+
+    clk = VirtualClock()
+    cfg = ServeConfig(batch_deadline_s=1.0, batch_max=4, nw=8,
+                      socket_path=str(tmp_path / "x.sock"))
+    srv = server_mod.SolverServer(cfg, clock=clk)   # never started: the
+    # delivery path is exercised directly on hand-built lanes
+
+    class _FakeConn:
+        def __init__(self):
+            self.sent = []
+
+        def send(self, obj):
+            self.sent.append(obj)
+            return True
+
+    conn = _FakeConn()
+    clk.t = 5.0
+    pend = server_mod._PendingRequest(conn, "r1", 2, clk, op="dlc",
+                                      trace="t-virt")
+    lanes = [Lane(request_id=pend, seq=0, label="a", staged=None,
+                  trace="t-virt", t_submit=6.0),
+             Lane(request_id=pend, seq=1, label="b", staged=None,
+                  trace="t-virt", t_submit=6.5)]
+    clk.t = 7.25                               # the batch closes here
+    srv._deliver(lanes, [{"lane": 0}, {"lane": 1}], 7.25)
+    resp = conn.sent[0]
+    assert resp["ok"] and resp["trace"] == "t-virt"
+    # EXACT equality, not approx: close minus submit on the same clock
+    assert resp["t_queue_s"] == [1.25, 0.75]
+    assert resp["t_total_s"] == 2.25           # deliver at 7.25, t0 5.0
+    rec = srv.flight.snapshot()[0]
+    assert rec["queue_wait_s"] == [1.25, 0.75]
+    assert rec["outcome"] == "ok" and rec["trace"] == "t-virt"
+    # windowed SLO: one request of latency 2.25 s -> p50 == p99 == the
+    # covering log-bucket upper edge
+    win = srv._slo_latency.window(now=clk.t)
+    edges = metrics.Histogram.edges
+    import bisect
+
+    expect = edges[bisect.bisect_left(edges, 2.25)]
+    assert win["count"] == 1
+    # the window reports 6-significant-digit JSON-safe floats
+    assert win["p50"] == win["p99"] == pytest.approx(expect, rel=1e-5)
+    tel = srv.telemetry()
+    assert tel["error_budget"] == {"requests": 1, "errors": 0,
+                                   "error_rate": 0.0}
+    assert tel["latency"]["count"] == 1
+    assert tel["flight"]["recorded"] == 1
+
+
+def test_stats_op_returns_telemetry_block(server):
+    from raft_tpu.serve.client import SolveClient
+
+    with SolveClient(server.socket_path) as cl:
+        r = cl.solve("oc3", 6.0, 10.0, timeout=180.0)
+        assert r["ok"]
+        st = cl.stats()
+    tel = st["telemetry"]
+    assert {"uptime_s", "window_s", "latency", "queue_wait", "occupancy",
+            "queue_depth", "error_budget", "compiles", "flight",
+            "ledger"} <= set(tel)
+    lat = tel["latency"]
+    assert lat["count"] >= 1 and 0 < lat["p50"] <= lat["p99"]
+    assert lat["error_rate"] == 0.0
+    assert tel["error_budget"]["requests"] >= 1
+    # per-bucket queue-wait windows carry the same shape
+    assert tel["queue_wait"]
+    for w in tel["queue_wait"].values():
+        assert {"count", "p50", "p99", "error_rate"} <= set(w)
+    assert tel["flight"]["recorded"] >= 1
+
+
+def test_reset_telemetry_is_a_window_boundary(tmp_path):
+    from raft_tpu.serve import server as server_mod
+
+    clk = VirtualClock()
+    cfg = ServeConfig(socket_path=str(tmp_path / "y.sock"))
+    srv = server_mod.SolverServer(cfg, clock=clk)
+    srv._slo_latency.observe(0.1, now=0.0)
+    with srv._lock:
+        srv._req_done = 5
+    srv.reset_telemetry()
+    assert srv._slo_latency.window(now=0.0)["count"] == 0
+    assert srv.telemetry()["error_budget"]["requests"] == 0
 
 
 # --------------------------------------------------------------------------

@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke serve-smoke lint lint-budgets
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke serve-smoke bem-smoke lint lint-budgets
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -33,6 +33,9 @@ race-smoke:      ## deterministic N-thread race proof: single-flight AOT compile
 
 serve-smoke:     ## resident-daemon proof: compiles == buckets, solo parity, warm
 	python -m raft_tpu.serve smoke   # restart 0 compiles; armed obs leg: request traces/SLO/flight/ledger
+
+bem-smoke:       ## on-device BEM proof: novel geometry solves with g++ POISONED
+	python -m raft_tpu.hydro.bem_smoke   # (no host solver), oracle parity, warm/novel zero compiles
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

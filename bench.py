@@ -628,6 +628,76 @@ def hetero_buckets(nw: int = 64, n_iter: int = 30):
     }
 
 
+def bem_block(nw: int = 16, dz_max: float = 1.0, da_max: float = 0.9):
+    """The ``bem`` bench block: novel-geometry BEM staging, native host
+    vs on-device (``workloads.bem`` -> ``bench.bem`` in EVIDENCE.json).
+
+    The staging-cliff claim (ROADMAP item 2): with the native C++ path
+    every geometry that misses the content-addressed result cache pays a
+    serial host solve; the on-device path
+    (:func:`raft_tpu.hydro.jax_bem.solve_bem_jax`) compiles one
+    executable PER PANEL SIZE CLASS, so a *novel* geometry on a warm
+    process pays only the device solve.  Three legs, all cache-cold
+    (``cache=False`` — no result-cache hits anywhere):
+
+    * ``native_solve_s`` — the host OpenMP f64 solve on novel geometry A;
+    * ``jax_cold_s`` — geometry A on device, first-ever (compile+solve);
+    * ``jax_novel_s`` — geometry B (different dimensions, same ``panels``
+      ladder class, never seen by any cache) on the now-warm executable:
+      THE novel-geometry cost the tentpole removes.
+
+    Parity vs the f64 oracle and the refinement residual ride along so
+    the speedup is never quoted without its accuracy bill.
+    """
+    from raft_tpu.hydro import jax_bem
+    from raft_tpu.hydro.bem_smoke import novel_mesh
+    from raft_tpu.hydro.native_bem import solve_bem
+
+    mesh_a = novel_mesh(1.45, 7.3, 9.1, dz_max=dz_max, da_max=da_max)
+    mesh_b = novel_mesh(1.33, 6.9, 8.7, dz_max=dz_max, da_max=da_max)
+    w = np.linspace(0.3, 1.8, nw)
+    kw = dict(rho=1025.0, g=9.81, beta=0.2, depth=50.0, cache=False)
+
+    t0 = time.perf_counter()
+    A_n, B_n, F_n = solve_bem(mesh_a, w, **kw)
+    native_s = time.perf_counter() - t0
+
+    t0 = time.perf_counter()
+    A_j, B_j, F_j, diag_a = jax_bem.solve_bem_jax(
+        mesh_a, w, return_diagnostics=True, **kw)
+    jax_cold_s = time.perf_counter() - t0
+    t0 = time.perf_counter()
+    _, _, _, diag_b = jax_bem.solve_bem_jax(
+        mesh_b, w, return_diagnostics=True, **kw)
+    jax_novel_s = time.perf_counter() - t0
+
+    err = jax_bem.parity_err
+    parity = {"A": err(A_j, A_n), "B": err(B_j, B_n), "F": err(F_j, F_n)}
+    padded = int(diag_b["padded"])
+    return {
+        "mode": jax_bem.resolved_mode(),
+        "panels": {"a": int(len(mesh_a)), "b": int(len(mesh_b)),
+                   "padded": padded},
+        "nw": nw,
+        "native_solve_s": round(native_s, 3),
+        "jax_cold_s": round(jax_cold_s, 3),
+        "jax_novel_s": round(jax_novel_s, 3),
+        # the headline: novel-geometry staging, host path vs warm device
+        "novel_speedup_vs_native": round(native_s / max(jax_novel_s, 1e-9),
+                                         2),
+        "novel_faster_than_native": bool(jax_novel_s < native_s),
+        # padded influence-matrix rows solved per second on the warm path
+        "panel_rows_per_s": round(padded * nw / max(jax_novel_s, 1e-9), 1),
+        "refine_iters": int(diag_b["refine_iters"]),
+        "max_residual": float(max(diag_a["max_residual"],
+                                  diag_b["max_residual"])),
+        "parity_vs_native": parity,
+        "parity_rtol": jax_bem.PARITY_RTOL,
+        "parity_ok": bool(all(v <= jax_bem.PARITY_RTOL
+                              for v in parity.values())),
+    }
+
+
 def serving_block(n_requests: int = 48, rate: float = 400.0,
                   nw: int = 24, n_iter: int = 15, batch_max: int = 8,
                   deadline_ms: float = 50.0):
@@ -1134,6 +1204,15 @@ def main():
             sv = serving_block(**({} if not fallback else
                                   {"n_requests": 24, "nw": 16,
                                    "n_iter": 10}))
+        with prof.phase("bem_block"):
+            # novel-geometry BEM staging: native host vs on-device (the
+            # jax_bem staging-cliff claim; reduced mesh on CPU fallback)
+            try:
+                bem = bem_block(**({} if not fallback else
+                                   {"nw": 6, "dz_max": 1.6,
+                                    "da_max": 1.3}))
+            except Exception as e:
+                bem = {"error": f"{type(e).__name__}: {str(e)[-300:]}"}
         pallas = None
         if not fallback and platform not in (None, "cpu"):
             # measure the hand-written kernel on the hardware it exists
@@ -1166,6 +1245,7 @@ def main():
                 },
                 "hetero_buckets": hb,
                 "serving": sv,
+                "bem": bem,
                 **({"pallas6_microbench": pallas} if pallas else {}),
             },
             "serial_baseline_solves_per_s": {

@@ -94,8 +94,9 @@ class ArrayModel:
                 nT = len(positions) if positions is not None else 1
             designs = [designs] * nT
         self.designs = list(designs)
-        # BEM: None (pure Morison), 'native' (mesh + solve once, shared
-        # across turbines -- requires identical designs), or precomputed
+        # BEM: None (pure Morison), a mode string ('native' | 'jax' |
+        # 'auto' — mesh + solve once, shared across turbines, requires
+        # identical designs; routing per Model.calcBEM), or precomputed
         # (A[6,6,nw], B[6,6,nw], F[6,nw]) host arrays.  Per-turbine incident
         # phase is applied to the staged excitation at solve time.
         if BEM is not None and any(d is not self.designs[0] for d in self.designs):
@@ -103,6 +104,10 @@ class ArrayModel:
                 "BEM in arrays requires identical turbine designs (shared "
                 "coefficients); mixed-design arrays run strip-theory only"
             )
+        if isinstance(BEM, str) and BEM not in ("native", "jax", "auto"):
+            raise ValueError(
+                f"BEM={BEM!r}: expected 'native', 'jax', 'auto', or a "
+                "precomputed (A, B, F) tuple")
         self.bem_mode = BEM if isinstance(BEM, str) else None
         self.bem = BEM if not isinstance(BEM, str) else None
         self._bem_staged = None
@@ -214,7 +219,7 @@ class ArrayModel:
         ``setEnv(beta=...)`` calls re-stage by interpolation without
         re-running the solver."""
         from raft_tpu.hydro.mesh import mesh_design, mesh_lid
-        from raft_tpu.hydro.native_bem import solve_bem
+        from raft_tpu.hydro.jax_bem import solve_bem_any
 
         with phase("array-calcBEM"):
             panels = mesh_design(self.designs[0], dz_max=dz_max, da_max=da_max)
@@ -227,12 +232,14 @@ class ArrayModel:
                 self._bem_headings, self.bem = solve_bem_heading_grid(
                     panels, self.w, float(self.env.rho), float(self.env.g),
                     self.depth, lid, headings, float(self.env.beta),
+                    mode=self.bem_mode,
                 )
             else:
-                self.bem = solve_bem(
+                self.bem = solve_bem_any(
                     panels, np.asarray(self.w),
                     rho=float(self.env.rho), g=float(self.env.g),
                     beta=float(self.env.beta), depth=self.depth, lid=lid,
+                    mode=self.bem_mode,
                 )
                 # only after a SUCCESSFUL solve (cf. Model.calcBEM)
                 self._bem_headings = None
@@ -241,7 +248,7 @@ class ArrayModel:
     def calcSystemProps(self):
         if self.wave is None:
             self.setEnv()
-        if self.bem_mode == "native" and self.bem is None:
+        if self.bem_mode is not None and self.bem is None:
             self.calcBEM()
         exclude = self.bem is not None
         env, wave = self.env, self.wave

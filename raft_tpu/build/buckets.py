@@ -52,6 +52,11 @@ DEFAULT_LADDER: dict = {
     "segments": (16, 48, 96, 192, 384),
     "nodes": (64, 128, 256, 512, 1024),
     "nw": (16, 32, 64, 128, 256, 512),
+    # BEM panel-mesh size classes (hull + lid panels = the influence-
+    # matrix dimension of hydro/jax_bem.py): padded with degenerate
+    # zero-area panels so every mesh of a class shares one compiled
+    # on-device solve — same contract as the member axes above
+    "panels": (64, 128, 256, 512, 768, 1024, 1536, 2048),
 }
 
 _AXES = tuple(DEFAULT_LADDER)

@@ -308,15 +308,21 @@ def _consts_fingerprint(consts) -> str:
 
 def _solver_salts() -> tuple:
     """Runtime knobs that change the traced/compiled program without
-    appearing in any argument: the Pallas kernel routing, x64 mode,
-    matmul precision, and raw XLA flags.  Keyed centrally so no call site
-    can forget them — JAX's persistent compile cache keys on its compile
-    options, and the AOT layer must not bypass that protection."""
+    appearing in any argument: the Pallas kernel routing, the BEM solver
+    routing, x64 mode, matmul precision, and raw XLA flags.  Keyed
+    centrally so no call site can forget them — JAX's persistent compile
+    cache keys on its compile options, and the AOT layer must not bypass
+    that protection.  (RAFT_TPU_BEM changes which solver produced the
+    STAGED coefficient values feeding downstream executables — the jax
+    and native paths agree only to the documented parity tolerance, not
+    bitwise — so a mode flip must invalidate rather than alias.)"""
     import jax
 
     from raft_tpu.core import pallas6
+    from raft_tpu.hydro import jax_bem
 
     return ("pallas", bool(pallas6.enabled()),
+            "bem_mode", jax_bem.resolved_mode(),
             "x64", bool(jax.config.jax_enable_x64),
             "matmul", str(getattr(jax.config, "jax_default_matmul_precision",
                                   None)),

@@ -1,0 +1,130 @@
+"""Device-evaluable Bessel functions J0, J1, Y0, Y1.
+
+The free-surface Green function of the on-device BEM
+(:mod:`raft_tpu.hydro.jax_bem`) needs J0/J1 (radiated-wave part) at every
+panel pair and Y0/Y1 in the large-X far field, but ``jax.scipy.special``
+ships neither Y_n nor an f32-friendly J_n.  These are the standard
+Abramowitz & Stegun rational/asymptotic approximations (the Numerical
+Recipes coefficients): absolute error < 2e-7 over the real line — below
+f32 resolution, which is all the f32 BEM blocks can use anyway.  Pure
+``jnp`` elementwise ops: vmappable, differentiable, TPU-native.
+"""
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_2_OVER_PI = 0.636619772367581343
+
+
+def _poly(y, coeffs):
+    acc = coeffs[-1]
+    for c in reversed(coeffs[:-1]):
+        acc = c + y * acc
+    return acc
+
+
+def _j0_small(y):
+    num = _poly(y, (57568490574.0, -13362590354.0, 651619640.7,
+                    -11214424.18, 77392.33017, -184.9052456))
+    den = _poly(y, (57568490411.0, 1029532985.0, 9494680.718,
+                    59272.64853, 267.8532712, 1.0))
+    return num / den
+
+
+def _j0_large(ax):
+    z = 8.0 / ax
+    y = z * z
+    xx = ax - 0.785398164
+    p = _poly(y, (1.0, -0.1098628627e-2, 0.2734510407e-4,
+                  -0.2073370639e-5, 0.2093887211e-6))
+    q = _poly(y, (-0.1562499995e-1, 0.1430488765e-3, -0.6911147651e-5,
+                  0.7621095161e-6, -0.934935152e-7))
+    return jnp.sqrt(_2_OVER_PI / ax) * (jnp.cos(xx) * p
+                                        - z * jnp.sin(xx) * q)
+
+
+def j0(x):
+    ax = jnp.abs(x)
+    small = ax < 8.0
+    ax_l = jnp.where(small, 8.0, ax)            # double-where: keep the
+    y = jnp.where(small, ax * ax, 0.0)          # untaken branch finite
+    return jnp.where(small, _j0_small(y), _j0_large(ax_l))
+
+
+def _j1_small(x, y):
+    num = x * _poly(y, (72362614232.0, -7895059235.0, 242396853.1,
+                        -2972611.439, 15704.48260, -30.16036606))
+    den = _poly(y, (144725228442.0, 2300535178.0, 18583304.74,
+                    99447.43394, 376.9991397, 1.0))
+    return num / den
+
+
+def _j1_large(ax):
+    z = 8.0 / ax
+    y = z * z
+    xx = ax - 2.356194491
+    p = _poly(y, (1.0, 0.183105e-2, -0.3516396496e-4, 0.2457520174e-5,
+                  -0.240337019e-6))
+    q = _poly(y, (0.04687499995, -0.2002690873e-3, 0.8449199096e-5,
+                  -0.88228987e-6, 0.105787412e-6))
+    return jnp.sqrt(_2_OVER_PI / ax) * (jnp.cos(xx) * p
+                                        - z * jnp.sin(xx) * q)
+
+
+def j1(x):
+    ax = jnp.abs(x)
+    small = ax < 8.0
+    ax_l = jnp.where(small, 8.0, ax)
+    y = jnp.where(small, ax * ax, 0.0)
+    out = jnp.where(small, _j1_small(ax, y), _j1_large(ax_l))
+    return jnp.sign(x) * jnp.where(x == 0, 0.0, out)
+
+
+def y0(x):
+    """Y0 for x > 0 (guarded at 0: returns the value at a tiny clamp)."""
+    x = jnp.maximum(x, 1e-30)
+    small = x < 8.0
+    x_s = jnp.where(small, x, 1.0)
+    y = x_s * x_s
+    num = _poly(y, (-2957821389.0, 7062834065.0, -512359803.6,
+                    10879881.29, -86327.92757, 228.4622733))
+    den = _poly(y, (40076544269.0, 745249964.8, 7189466.438,
+                    47447.26470, 226.1030244, 1.0))
+    small_val = num / den + _2_OVER_PI * j0(x_s) * jnp.log(x_s)
+    x_l = jnp.where(small, 8.0, x)
+    z = 8.0 / x_l
+    yl = z * z
+    xx = x_l - 0.785398164
+    p = _poly(yl, (1.0, -0.1098628627e-2, 0.2734510407e-4,
+                   -0.2073370639e-5, 0.2093887211e-6))
+    q = _poly(yl, (-0.1562499995e-1, 0.1430488765e-3, -0.6911147651e-5,
+                   0.7621095161e-6, -0.934935152e-7))
+    large_val = jnp.sqrt(_2_OVER_PI / x_l) * (jnp.sin(xx) * p
+                                              + z * jnp.cos(xx) * q)
+    return jnp.where(small, small_val, large_val)
+
+
+def y1(x):
+    """Y1 for x > 0 (guarded at 0)."""
+    x = jnp.maximum(x, 1e-30)
+    small = x < 8.0
+    x_s = jnp.where(small, x, 1.0)
+    y = x_s * x_s
+    num = x_s * _poly(y, (-0.4900604943e13, 0.1275274390e13,
+                          -0.5153438139e11, 0.7349264551e9,
+                          -0.4237922726e7, 0.8511937935e4))
+    den = _poly(y, (0.2499580570e14, 0.4244419664e12, 0.3733650367e10,
+                    0.2245904002e8, 0.1020426050e6, 0.3549632885e3, 1.0))
+    small_val = num / den + _2_OVER_PI * (j1(x_s) * jnp.log(x_s)
+                                          - 1.0 / x_s)
+    x_l = jnp.where(small, 8.0, x)
+    z = 8.0 / x_l
+    yl = z * z
+    xx = x_l - 2.356194491
+    p = _poly(yl, (1.0, 0.183105e-2, -0.3516396496e-4, 0.2457520174e-5,
+                   -0.240337019e-6))
+    q = _poly(yl, (0.04687499995, -0.2002690873e-3, 0.8449199096e-5,
+                   -0.88228987e-6, 0.105787412e-6))
+    large_val = jnp.sqrt(_2_OVER_PI / x_l) * (jnp.sin(xx) * p
+                                              + z * jnp.cos(xx) * q)
+    return jnp.where(small, small_val, large_val)

@@ -160,6 +160,12 @@ def main():
         bb = bench_json.get("workloads", {}).get("hetero_buckets")
         if bb is not None:
             bench["buckets"] = bb
+        # on-device BEM staging (novel-geometry native-host vs device
+        # solve, parity vs the f64 oracle, refinement residual): the
+        # staging-cliff claim one key deep
+        bem = bench_json.get("workloads", {}).get("bem")
+        if bem is not None:
+            bench["bem"] = bem
         # unified observability block (raft_tpu.obs): span roll-up +
         # metric snapshot with latency histogram quantiles + per-tag
         # compile counts — the measured-telemetry story one key deep

@@ -1,0 +1,199 @@
+"""Cross-process proof of the on-device BEM (`make bem-smoke`).
+
+The claim (ROADMAP item 2 / the jax_bem tentpole): a *novel* (uncached)
+geometry solves ON DEVICE — no g++ invocation, no host C++ solver — with
+parity against the native f64 oracle, and a warm process pays ZERO
+compiles for a second novel geometry of the same panel size class.
+
+Protocol (real process boundaries, the cache-/hetero-/serve-smoke rule):
+
+1. The PARENT builds the native oracle (real toolchain allowed here) and
+   pre-warms the design-independent wave-integral table into a fresh
+   workspace cache root (pure numpy — no g++ involved).
+2. CHILD 1 runs with ``RAFT_TPU_BEM=jax``, the fresh cache root, and a
+   POISONED ``g++`` on PATH (a stub that drops a marker file and exits
+   1): it solves novel geometry A cold (compile + solve) and writes
+   A/B/F + diagnostics.  Any attempt to reach the toolchain either
+   fails the child loudly or leaves the marker — both are detected.
+3. CHILD 2 repeats geometry A warm: ZERO compiles (AOT disk hit).
+4. CHILD 3 solves novel geometry B (different dimensions, same ``panels``
+   ladder class, cache-cold content): ZERO compiles — a novel geometry
+   on a warm executable pays only the device solve.
+5. The parent solves both geometries through the native oracle
+   (``cache=False``) and pins max scale-relative |jax - native| on A, B
+   and F within :data:`raft_tpu.hydro.jax_bem.PARITY_RTOL`.
+
+Prints exactly ONE JSON line; exits 0 iff every check passed.
+"""
+from __future__ import annotations
+
+import json
+import os
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+import numpy as np
+
+
+def novel_mesh(r: float, draft: float, spacing: float,
+               dz_max: float = 1.6, da_max: float = 1.3) -> np.ndarray:
+    """A deterministic 'novel' two-column platform mesh — dimensions are
+    deliberately unlike any shipped design, so nothing content-cached can
+    match.  Shared by this smoke and the bench ``bem`` block (one mesh
+    recipe, two measurements)."""
+    from raft_tpu.hydro.mesh import mesh_member
+
+    cols = []
+    for sx in (-0.5, 0.5):
+        cols.append(mesh_member(
+            stations=[0.0, draft + 2.0], diameters=[2 * r, 2 * r],
+            rA=[sx * spacing, 0.0, -draft], rB=[sx * spacing, 0.0, 2.0],
+            dz_max=dz_max, da_max=da_max))
+    return np.concatenate(cols, axis=0)
+
+
+def smoke_mesh(variant: str) -> np.ndarray:
+    """Variants A/B differ in radius/draft/spacing but land in the same
+    ``panels`` ladder class (the novel-geometry-zero-compile claim)."""
+    if variant == "a":
+        return novel_mesh(1.13, 5.7, 7.9)
+    if variant == "b":
+        return novel_mesh(1.19, 5.9, 8.3)
+    raise ValueError(variant)
+
+
+_W = np.linspace(0.4, 1.6, 4)
+_RHO, _G, _DEPTH, _BETA = 1025.0, 9.81, 40.0, 0.3
+
+
+def _child(variant: str, out_path: str) -> int:
+    from raft_tpu import cache
+    from raft_tpu.hydro.jax_bem import solve_bem_jax
+
+    cache.enable()                     # root from RAFT_TPU_CACHE_DIR
+    panels = smoke_mesh(variant)
+    t0 = time.perf_counter()
+    A, B, F, diag = solve_bem_jax(
+        panels, _W, rho=_RHO, g=_G, beta=_BETA, depth=_DEPTH,
+        cache=False, return_diagnostics=True)
+    wall = time.perf_counter() - t0
+    from raft_tpu.cache.aot import compile_count
+
+    np.savez(out_path, A=A, B=B, F_re=F.real, F_im=F.imag,
+             wall_s=wall, compiles=compile_count("jax_bem"),
+             max_residual=diag["max_residual"], padded=diag["padded"])
+    return 0
+
+
+def main() -> int:
+    t_start = time.perf_counter()
+    ws = tempfile.mkdtemp(prefix="raft-bem-smoke-")
+    result: dict = {"ok": False}
+    try:
+        root = os.path.join(ws, "cache")
+        os.makedirs(root, exist_ok=True)
+        # poisoned toolchain for the children
+        poison = os.path.join(ws, "bin")
+        os.makedirs(poison, exist_ok=True)
+        marker = os.path.join(ws, "gxx-invoked")
+        for tool in ("g++", "gcc", "c++"):
+            path = os.path.join(poison, tool)
+            with open(path, "w") as f:
+                f.write("#!/bin/sh\n"
+                        f"touch {marker}\n"
+                        "echo 'bem-smoke: toolchain poisoned' >&2\n"
+                        "exit 1\n")
+            os.chmod(path, 0o755)
+
+        # parent: native oracle (real toolchain) + table pre-warm
+        from raft_tpu.hydro import jax_bem, wavetable
+        from raft_tpu.hydro.native_bem import solve_bem
+
+        oracle = {v: solve_bem(smoke_mesh(v), _W, rho=_RHO, g=_G,
+                               beta=_BETA, depth=_DEPTH, cache=False)
+                  for v in ("a", "b")}
+        wavetable.load_tables()        # build once under the default root
+        tab_src = wavetable._cache_path()
+        tab_dst = os.path.join(root, "wavetable",
+                               os.path.basename(tab_src))
+        os.makedirs(os.path.dirname(tab_dst), exist_ok=True)
+        shutil.copy(tab_src, tab_dst)
+
+        env = dict(os.environ)
+        env["PATH"] = poison + os.pathsep + env.get("PATH", "")
+        env["RAFT_TPU_BEM"] = "jax"
+        env["RAFT_TPU_CACHE_DIR"] = root
+        env.setdefault("JAX_PLATFORMS", "cpu")
+
+        def run_child(variant, tag):
+            out = os.path.join(ws, f"{tag}.npz")
+            t0 = time.perf_counter()
+            proc = subprocess.run(
+                [sys.executable, "-m", "raft_tpu.hydro.bem_smoke",
+                 "--child", variant, out],
+                env=env, timeout=600, capture_output=True, text=True)
+            if proc.returncode != 0:
+                raise RuntimeError(
+                    f"child {tag} rc={proc.returncode}: "
+                    f"{proc.stderr[-800:]}")
+            with np.load(out) as z:
+                return {k: z[k] for k in z.files} | {
+                    "child_wall_s": time.perf_counter() - t0}
+
+        cold = run_child("a", "cold")
+        warm = run_child("a", "warm")
+        novel = run_child("b", "novel")
+
+        def parity(got, variant):
+            An, Bn, Fn = oracle[variant]
+            F = got["F_re"] + 1j * got["F_im"]
+            err = jax_bem.parity_err
+            return {"A": err(got["A"], An), "B": err(got["B"], Bn),
+                    "F": err(F, Fn)}
+
+        par_a = parity(cold, "a")
+        par_b = parity(novel, "b")
+        tol = jax_bem.PARITY_RTOL
+        checks = {
+            "gxx_never_invoked": not os.path.exists(marker),
+            "cold_compiled": int(cold["compiles"]) >= 1,
+            "warm_zero_compiles": int(warm["compiles"]) == 0,
+            "novel_zero_compiles": int(novel["compiles"]) == 0,
+            "warm_faster_than_cold":
+                float(warm["wall_s"]) < float(cold["wall_s"]),
+            "parity_a": all(v <= tol for v in par_a.values()),
+            "parity_b": all(v <= tol for v in par_b.values()),
+            "residual_small":
+                max(float(cold["max_residual"]),
+                    float(novel["max_residual"])) < 1e-4,
+        }
+        result = {
+            "ok": all(checks.values()),
+            "checks": checks,
+            "parity": {"a": par_a, "b": par_b, "rtol": tol},
+            "cold_solve_s": float(cold["wall_s"]),
+            "warm_solve_s": float(warm["wall_s"]),
+            "novel_solve_s": float(novel["wall_s"]),
+            "compiles": {"cold": int(cold["compiles"]),
+                         "warm": int(warm["compiles"]),
+                         "novel": int(novel["compiles"])},
+            "padded_panels": int(cold["padded"]),
+            "max_residual": float(max(cold["max_residual"],
+                                      novel["max_residual"])),
+            "wall_s": time.perf_counter() - t_start,
+        }
+    except Exception as e:                       # noqa: BLE001
+        result["error"] = f"{type(e).__name__}: {e}"
+    finally:
+        shutil.rmtree(ws, ignore_errors=True)
+    print(json.dumps(result))
+    return 0 if result.get("ok") else 1
+
+
+if __name__ == "__main__":
+    if len(sys.argv) >= 4 and sys.argv[1] == "--child":
+        sys.exit(_child(sys.argv[2], sys.argv[3]))
+    sys.exit(main())

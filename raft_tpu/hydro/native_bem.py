@@ -26,9 +26,26 @@ import numpy as np
 _SRC = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
                     "native", "bem.cpp")
 _LIB_DIR = os.path.join(os.path.dirname(_SRC), "_build")
-_LIB = os.path.join(_LIB_DIR, "libraft_bem.so")
 
 _lib = None
+
+
+def _src_digest() -> str:
+    h = hashlib.sha256()
+    with open(_SRC, "rb") as f:
+        h.update(f.read())
+    return h.hexdigest()
+
+
+def _lib_path() -> str:
+    """The shared-library path, keyed by a CONTENT hash of ``bem.cpp`` —
+    the same contract the result cache already uses.  The old freshness
+    check compared mtimes (``getmtime(_LIB) >= src_mtime``), which a git
+    checkout can regress (checkout rewrites the source with an older
+    mtime than the built artifact), silently serving a stale solver; a
+    content key cannot go stale, and editing the source simply lands on
+    a new path."""
+    return os.path.join(_LIB_DIR, f"libraft_bem-{_src_digest()[:16]}.so")
 
 
 def _build_lib() -> str:
@@ -41,15 +58,14 @@ def _build_lib() -> str:
     diagnostic, safe for committed artifacts) instead of the full spew.
     """
     os.makedirs(_LIB_DIR, exist_ok=True)
-    src_mtime = os.path.getmtime(_SRC)
-    if os.path.exists(_LIB) and os.path.getmtime(_LIB) >= src_mtime:
-        return _LIB
+    lib = _lib_path()
+    if os.path.exists(lib):
+        return lib
     from raft_tpu.resilience import retry as _retry
 
     # compile to a tmp path and publish atomically: a timeout-KILLED g++
-    # can leave a partial object, and the mtime freshness check above
-    # would serve that corrupt .so to ctypes forever
-    tmp = _LIB + f".tmp.{os.getpid()}"
+    # can leave a partial object under an existence-checked key
+    tmp = lib + f".tmp.{os.getpid()}"
     cmd = [
         "g++", "-O3", "-march=native", "-fopenmp", "-shared", "-fPIC",
         _SRC, "-o", tmp, "-lm",
@@ -66,7 +82,7 @@ def _build_lib() -> str:
                 retry_on=(_retry.SubprocessFailed,),
                 describe="BEM solver build",
             )
-        os.replace(tmp, _LIB)
+        os.replace(tmp, lib)
     except _retry.RetryExhausted as e:
         last = e.last
         tail = getattr(last, "stderr_tail", "") or str(last)[-300:]
@@ -80,7 +96,7 @@ def _build_lib() -> str:
                 os.remove(tmp)
             except OSError:
                 pass
-    return _LIB
+    return lib
 
 
 def _load():
@@ -113,6 +129,89 @@ def _load():
         lib.bem_wave_integral_direct.argtypes = lib.bem_wave_integral.argtypes
         _lib = lib
     return _lib
+
+
+# ------------------------------------------------- shared result cache --
+#
+# Content-addressed npz result cache shared by the native and the JAX
+# (hydro/jax_bem.py) panel solvers: atomic tmp+os.replace publish, and a
+# corrupt artifact (torn write, bit rot, missing keys) is a *counted*
+# MISS — deleted and recomputed, never served, never silent.  The
+# ``bem.cache_corrupt`` counter (ChunkStore's ckpt.corrupt precedent)
+# makes corruption observable instead of a quiet unlink.
+
+
+def _cache_base(namespace: str) -> str:
+    # the solver result caches predate the warm-start subsystem and are
+    # governed by the callers' ``cache`` flag, but they follow a
+    # RAFT_TPU_CACHE_DIR relocation so one root holds every layer
+    # (``off`` only disables the warm-start layers, not these: the
+    # artifacts are exact solver output, so hits are bit-identical)
+    from raft_tpu.cache import config as _cache_config
+
+    root = _cache_config.cache_dir() or _cache_config.resolve_dir()
+    return (os.path.join(root, namespace) if root is not None
+            else os.path.expanduser(f"~/.cache/raft_tpu/{namespace}"))
+
+
+def result_cache_key(namespace: str, panels, w, betas, scalars,
+                     salt=(), extra_bytes: bytes = b"") -> str:
+    """Content-addressed artifact path for one solve's inputs."""
+    import numpy as _np
+
+    h = hashlib.sha256()
+    for part in salt:
+        h.update(repr(part).encode())
+    h.update(_np.ascontiguousarray(panels).tobytes())
+    h.update(_np.ascontiguousarray(w).tobytes())
+    h.update(_np.ascontiguousarray(betas).tobytes())
+    h.update(_np.asarray(scalars, dtype=_np.float64).tobytes())
+    h.update(extra_bytes)
+    return os.path.join(_cache_base(namespace), h.hexdigest()[:24] + ".npz")
+
+
+def result_cache_load(key: str, needed) -> dict | None:
+    """Load a cached solve result; corrupt/incomplete artifacts count
+    ``bem.cache_corrupt`` and are deleted (a MISS)."""
+    from raft_tpu import obs as _obs
+
+    if not os.path.exists(key):
+        _obs.metrics.counter("bem.cache_miss").inc()
+        return None
+    try:
+        with np.load(key) as z:
+            names = set(z.files)
+            needed = set(needed)
+            if not needed <= names:
+                raise KeyError(sorted(needed - names))
+            out = {k: z[k].copy() for k in needed}
+        _obs.metrics.counter("bem.cache_hit").inc()
+        return out
+    except Exception:
+        _obs.metrics.counter("bem.cache_corrupt").inc()
+        _obs.metrics.counter("bem.cache_miss").inc()
+        try:
+            os.unlink(key)
+        except OSError:
+            pass
+        return None
+
+
+def result_cache_store(key: str, payload: dict) -> None:
+    """Atomic tmp + os.replace publish under the content-addressed key
+    (GL202: a kill mid-write must never leave a torn npz that an
+    existence freshness check would serve)."""
+    os.makedirs(os.path.dirname(key), exist_ok=True)
+    import tempfile
+
+    fd, tmp = tempfile.mkstemp(dir=os.path.dirname(key), suffix=".tmp")
+    try:
+        with os.fdopen(fd, "wb") as f:
+            np.savez_compressed(f, **payload)
+        os.replace(tmp, key)
+    finally:
+        if os.path.exists(tmp):
+            os.unlink(tmp)
 
 
 def wave_integral(X: float, Y: float, direct: bool = False):
@@ -194,52 +293,20 @@ def solve_bem(
 
     key = None
     if cache:
-        h = hashlib.sha256()
-        with open(_SRC, "rb") as f:
-            h.update(f.read())                # solver edits invalidate cache
-        h.update(panels.tobytes())
-        h.update(w.tobytes())
-        h.update(betas.tobytes())
-        h.update(np.array([rho, g, depth, float(haskind), float(n_lid)]).tobytes())
-        # the solver result cache predates the warm-start subsystem and is
-        # governed by this function's own ``cache`` flag, but it follows a
-        # RAFT_TPU_CACHE_DIR relocation so one root holds every layer
-        # (``off`` only disables the warm-start layers, not this one: the
-        # artifacts are exact solver output, so hits are bit-identical)
-        from raft_tpu.cache import config as _cache_config
-
-        # a programmatic enable(dir) wins over the env resolution, so one
-        # root really does hold every layer
-        root = _cache_config.cache_dir() or _cache_config.resolve_dir()
-        base = (os.path.join(root, "bem") if root is not None
-                else os.path.expanduser("~/.cache/raft_tpu/bem"))
-        key = os.path.join(base, h.hexdigest()[:24] + ".npz")
-        if os.path.exists(key):
-            # corruption tolerance (the staging-cache rule): a truncated
-            # or otherwise unreadable artifact is a MISS — deleted and
-            # recomputed, never served and never allowed to crash every
-            # future run with the same geometry
-            try:
-                with np.load(key) as z:
-                    names = set(z.files)
-                    needed = {"A", "B", "F"} | ({"Fh"} if haskind else set())
-                    if not needed <= names:
-                        raise KeyError(sorted(needed - names))
-                    out = (z["A"], z["B"],
-                           z["F"][0] if scalar_beta else z["F"])
-                    if haskind:
-                        out = out + ((z["Fh"][0] if scalar_beta
-                                      else z["Fh"]),)
-                    _obs.metrics.counter("bem.cache_hit").inc()
-                    return out
-            except Exception:
-                try:
-                    os.unlink(key)
-                except OSError:
-                    pass
-
-    if cache and key is not None:
-        _obs.metrics.counter("bem.cache_miss").inc()
+        # solver edits invalidate the cache: key on the source content
+        key = result_cache_key(
+            "bem", panels, w, betas,
+            (rho, g, depth, float(haskind), float(n_lid)),
+            salt=(_src_digest(),))
+        needed = ("A", "B", "F", "Fh") if haskind else ("A", "B", "F")
+        hit = result_cache_load(key, needed)
+        if hit is not None:
+            out = (hit["A"], hit["B"],
+                   hit["F"][0] if scalar_beta else hit["F"])
+            if haskind:
+                out = out + ((hit["Fh"][0] if scalar_beta
+                              else hit["Fh"]),)
+            return out
     lib = _load()
     A = np.zeros((n_w, 6, 6))
     B = np.zeros((n_w, 6, 6))
@@ -269,24 +336,10 @@ def solve_bem(
     Fh = (Fhre + 1j * Fhim).transpose(1, 2, 0) if haskind else None
 
     if cache and key is not None:
-        os.makedirs(os.path.dirname(key), exist_ok=True)
-        # atomic publish (tmp + os.replace): a kill mid-write must never
-        # leave a truncated npz under the content-addressed key — the
-        # freshness check is existence, so the torn file would be served
-        # (GL202, the same contract as cache/staging.py and checkpoint.py)
-        import tempfile
-
-        fd, tmp = tempfile.mkstemp(dir=os.path.dirname(key), suffix=".tmp")
-        try:
-            with os.fdopen(fd, "wb") as f:
-                payload = dict(A=A, B=B, F=F)
-                if haskind:
-                    payload["Fh"] = Fh
-                np.savez_compressed(f, **payload)
-            os.replace(tmp, key)
-        finally:
-            if os.path.exists(tmp):
-                os.unlink(tmp)
+        payload = dict(A=A, B=B, F=F)
+        if haskind:
+            payload["Fh"] = Fh
+        result_cache_store(key, payload)
     if scalar_beta:
         F = F[0]
         Fh = Fh[0] if haskind else None

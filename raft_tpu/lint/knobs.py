@@ -70,9 +70,14 @@ KNOBS: tuple[Knob, ...] = (
          salted_via="raft_tpu.cache.aot.donation_salt",
          salt_token="donate_argnums"),
     Knob("RAFT_TPU_BUCKETS", "built-in ladder", "build.buckets", AOT_KEY,
-         "Size-class ladder for shape-bucketed mixed-design megabatches",
+         "Size-class ladder for shape-bucketed mixed-design megabatches "
+         "(incl. the BEM panels axis)",
          salted_via="raft_tpu.build.buckets.ladder_salt",
          salt_token="buckets"),
+    Knob("RAFT_TPU_BEM", "auto (jax iff TPU)", "hydro.jax_bem", AOT_KEY,
+         "Panel-solver routing: native host C++, on-device JAX, or auto",
+         salted_via="raft_tpu.cache.aot._solver_salts",
+         salt_token="bem_mode"),
     Knob("XLA_FLAGS", "unset", "cache.aot", AOT_KEY,
          "Raw XLA compiler flags (device counts, HLO dumps, ...)",
          salted_via="raft_tpu.cache.aot._solver_salts",

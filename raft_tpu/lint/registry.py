@@ -310,6 +310,59 @@ def _entry_serve_solve():
     return fn, args, args2
 
 
+def _entry_jax_bem():
+    """Traced core of :func:`raft_tpu.hydro.jax_bem.solve_panels` — the
+    on-device panel solve (influence assembly + factor-once refined
+    solve) on a tiny padded deep-water mesh.  The two argument pytrees
+    are two DIFFERENT geometries (radial scales) padded to one ``panels``
+    ladder class — the zero-retrace budget is exactly the "a novel
+    geometry on a warm executable pays only the device solve" claim, and
+    the zero-f64 budget pins the f32-blocks-with-refinement contract."""
+    import functools
+
+    import numpy as np
+    import jax.numpy as jnp
+
+    from raft_tpu.hydro import jax_bem, wavetable
+
+    def mesh(scale):
+        th = np.linspace(0, np.pi, 4 + 1)
+        pans = []
+        for i in range(4):
+            for j in range(8):
+                p0, p1 = th[i], th[i + 1]
+                a0, a1 = 2 * np.pi * j / 8, 2 * np.pi * (j + 1) / 8
+                pt = lambda pp, aa: [scale * np.sin(pp) * np.cos(aa),
+                                     scale * np.sin(pp) * np.sin(aa),
+                                     -3.0 + scale * np.cos(pp)]
+                pans.append([pt(p0, a0), pt(p1, a0), pt(p1, a1),
+                             pt(p0, a1)])
+        return np.asarray(pans)
+
+    w = np.array([0.9, 1.4])
+    fd = wavetable.fd_fit_grid(w, -1.0, 9.81)
+    tab = jax_bem._stage_table(jnp.float32)
+
+    def args_for(scale):
+        padded, pm, lm = jax_bem._pad_mesh(mesh(scale), None)
+        return (jnp.asarray(padded, jnp.float32),
+                jnp.asarray(pm, jnp.float32), jnp.asarray(lm, jnp.float32),
+                jnp.asarray(w, jnp.float32),
+                jnp.asarray([0.0], jnp.float32),
+                {k: jnp.asarray(v, jnp.float32) for k, v in fd.items()},
+                tab)
+
+    fn = functools.partial(jax_bem.solve_panels, rho=1025.0, g=9.81,
+                           depth=0.0, finite_depth=False,
+                           dtype=jnp.float32)
+
+    def wrapped(*a):
+        A, B, F, resid = fn(*a)
+        return A, B, F.re, F.im, resid
+
+    return wrapped, args_for(1.0), args_for(1.07)
+
+
 def _entry_eigen():
     """Traced core of :func:`raft_tpu.solve.eigen.solve_eigen` — the
     generalized symmetric eigensolve (Cholesky + Jacobi sweeps)."""
@@ -350,6 +403,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
                _entry_sweep_designs, concurrent=True),
     EntryPoint("serve_solve", "raft_tpu.serve.solver.solve_batch",
                _entry_serve_solve, concurrent=True),
+    EntryPoint("jax_bem", "raft_tpu.hydro.jax_bem.solve_panels",
+               _entry_jax_bem),
 )
 
 #: the daemon-facing host functions whose whole call path falls under the

@@ -86,10 +86,16 @@ class Model:
         self.env = Env(depth=self.depth)
         self.wave: WaveState | None = None
         # BEM: None -> pure Morison (the reference snapshot's behavior,
-        # A_BEM=0, raft/raft.py:1797-1800); 'native' -> mesh the potMod
-        # members and run the C++ panel solver; or a precomputed
+        # A_BEM=0, raft/raft.py:1797-1800); a mode string -> mesh the
+        # potMod members and run the panel solver ('native' forces the C++
+        # host solver, 'jax' the on-device port, 'auto'/the historical
+        # 'native'-as-default routes per RAFT_TPU_BEM); or a precomputed
         # (A[6,6,nw], B[6,6,nw], F[6,nw]) tuple (e.g. from WAMIT files via
         # hydro.bem_io.load_wamit_coeffs)
+        if isinstance(BEM, str) and BEM not in ("native", "jax", "auto"):
+            raise ValueError(
+                f"BEM={BEM!r}: expected 'native', 'jax', 'auto', or a "
+                "precomputed (A, B, F) tuple")
         self.bem_mode = BEM if isinstance(BEM, str) else None
         self.bem = BEM if not isinstance(BEM, str) else None
         self._bem_headings = None        # staged heading grid (calcBEM)
@@ -163,9 +169,15 @@ class Model:
         by interpolation WITHOUT re-running the solver — the reference's
         HAMS heading-grid workflow (hams/pyhams.py:196-289) carried through
         the Model.  Writes HullMesh.pnl / platform.gdf when ``out_dir`` is
-        given, matching the reference's on-disk artifacts."""
+        given, matching the reference's on-disk artifacts.
+
+        The solver itself routes per the key-salted ``RAFT_TPU_BEM`` knob
+        (or an explicit ``Model(BEM="native"|"jax"|"auto")``): the native
+        f64 host solver, or the on-device JAX port
+        (:mod:`raft_tpu.hydro.jax_bem`) whose padded-shape executables
+        make novel geometries pay only a device solve."""
         from raft_tpu.hydro.mesh import mesh_design, mesh_lid, write_gdf, write_pnl
-        from raft_tpu.hydro.native_bem import solve_bem
+        from raft_tpu.hydro.jax_bem import solve_bem_any
 
         with phase("calcBEM"):
             panels = mesh_design(self.design, dz_max=dz_max, da_max=da_max)
@@ -184,12 +196,14 @@ class Model:
                 self._bem_headings, self.bem = solve_bem_heading_grid(
                     panels, self.w, float(self.env.rho), float(self.env.g),
                     self.depth, lid, headings, float(self.env.beta),
+                    mode=self.bem_mode,
                 )
             else:
-                self.bem = solve_bem(
+                self.bem = solve_bem_any(
                     panels, np.asarray(self.w),
                     rho=float(self.env.rho), g=float(self.env.g),
                     beta=float(self.env.beta), depth=self.depth, lid=lid,
+                    mode=self.bem_mode,
                 )
                 # only after a SUCCESSFUL solve: the fresh single-heading
                 # result supersedes any staged grid (a failed solve must
@@ -206,7 +220,7 @@ class Model:
         (cf. Model.calcSystemProps, raft/raft.py:1315-1330)."""
         if self.wave is None:
             self.setEnv()
-        if self.bem_mode == "native" and self.bem is None:
+        if self.bem_mode is not None and self.bem is None:
             self.calcBEM()
         exclude = self.bem is not None
         with phase("statics"):
@@ -670,16 +684,21 @@ def plot_member_wireframe(ax, m, offset=(0.0, 0.0), n_ring: int = 24):
             ax.plot(*np.stack([ringA[j], ringB[j]]).T, "k-", lw=0.4)
 
 
-def solve_bem_heading_grid(panels, w, rho, g, depth, lid, headings, beta):
+def solve_bem_heading_grid(panels, w, rho, g, depth, lid, headings, beta,
+                           mode=None):
     """Solve radiation once + diffraction for a whole heading grid, and
     stage the excitation at the current heading.
 
     Shared staging protocol of Model.calcBEM and ArrayModel.calcBEM:
     returns ``(bem_headings, bem)`` where ``bem_headings = (betas,
     F_all[nb,6,nw], A, B)`` is the grid for later re-staging and ``bem``
-    is the (A, B, F[6,nw]) tuple at ``beta``.
+    is the (A, B, F[6,nw]) tuple at ``beta``.  ``mode`` routes the
+    solver (native host / on-device JAX / auto — see
+    :func:`raft_tpu.hydro.jax_bem.solve_bem_any`); either way the
+    influence matrix factors once per frequency and every extra heading
+    is one extra back-substitution.
     """
-    from raft_tpu.hydro.native_bem import solve_bem
+    from raft_tpu.hydro.jax_bem import solve_bem_any
 
     betas = np.sort(np.asarray(headings, dtype=float))
     if not (betas[0] - 1e-9 <= beta <= betas[-1] + 1e-9):
@@ -688,8 +707,8 @@ def solve_bem_heading_grid(panels, w, rho, g, depth, lid, headings, beta):
             f"current heading {beta:.3f} rad outside the requested grid "
             f"[{betas[0]:.3f}, {betas[-1]:.3f}] — include it or setEnv first"
         )
-    A, B, F_all = solve_bem(panels, np.asarray(w), rho=rho, g=g,
-                            beta=betas, depth=depth, lid=lid)
+    A, B, F_all = solve_bem_any(panels, np.asarray(w), rho=rho, g=g,
+                                beta=betas, depth=depth, lid=lid, mode=mode)
     bem_headings = (betas, F_all, A, B)
     return bem_headings, (A, B, interp_heading_excitation(betas, F_all, beta))
 

@@ -72,7 +72,7 @@ def energy_sum(sigmas):
 
 def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
                n_iter, remat, case_reduce=None, moor=None,
-               moor_apply_fn=None, r6_moor=None):
+               moor_apply_fn=None, r6_moor=None, bem_fn=None):
     """theta -> objective(Xi) through the reverse-differentiable pipeline.
 
     With ``moor`` (a :class:`~raft_tpu.mooring.MooringSystem`) and
@@ -88,6 +88,14 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
     linearization fixed point under ``vmap`` and the per-case objectives
     reduce with ``case_reduce`` (default ``jnp.max`` — robust worst-case
     design over the DLC table).
+
+    ``bem_fn`` (exclusive with ``bem``) closes the co-design loop through
+    the panel solve itself: ``theta -> (A[nw,6,6], B[nw,6,6], F Cx[nw,6])``
+    re-solved differentiably INSIDE the loss
+    (:func:`raft_tpu.hydro.jax_bem.make_bem_fn`), so the gradient carries
+    the potential-flow coefficients' dependence on the hull geometry —
+    with a static ``bem`` they are frozen at the nominal hull (the
+    linearized-sweep convention).
 
     ``bem`` is detected by layout: :func:`~raft_tpu.parallel.sweep.
     stage_bem` output (excitation already zeta-scaled to ONE sea state,
@@ -105,6 +113,13 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
     batched = wave.zeta.ndim == 2
     if case_reduce is None:
         case_reduce = jnp.max
+    if bem_fn is not None and bem is not None:
+        raise ValueError("pass bem (frozen coefficients) OR bem_fn "
+                         "(differentiable re-solve), not both")
+    if bem_fn is not None and batched and wave.beta is not None:
+        raise ValueError(
+            "bem_fn solves one heading; lanes carrying their own wave "
+            "headings need the staged heading-grid bem instead")
     staged = None       # per-case zeta staging of one shared-heading layout
     staged_F = None     # per-lane heading-interpolated excitation
     if bem is not None:
@@ -143,8 +158,10 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
                 bem = _stage_zeta(staged, wave.zeta)
                 staged = None
 
-    def solve_one(m, C, wv, F_re=None, F_im=None):
-        if F_re is not None:
+    def solve_one(m, C, wv, F_re=None, F_im=None, staged_dyn=None):
+        if staged_dyn is not None:
+            b = _stage_zeta(staged_dyn, wv.zeta)
+        elif F_re is not None:
             b = _stage_zeta((staged_F[0], staged_F[1], F_re, F_im), wv.zeta)
         elif staged is not None:
             b = _stage_zeta(staged, wv.zeta)
@@ -158,6 +175,13 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
 
     def loss(theta):
         m = apply_fn(members, theta)
+        staged_dyn = None
+        if bem_fn is not None:
+            # the differentiable panel re-solve: coefficients become a
+            # function of theta INSIDE the loss (one solve per theta, the
+            # sea states share it — A/B/F are sea-state independent)
+            A_d, B_d, F_cx = bem_fn(theta)
+            staged_dyn = (A_d, B_d, F_cx.re, F_cx.im)
         if moor is not None:
             from raft_tpu.mooring import mooring_stiffness
 
@@ -173,9 +197,11 @@ def _make_loss(members, rna, env, wave, C_moor, objective, apply_fn, bem,
                     lambda wv, fr, fi: solve_one(m, C, wv, fr, fi)
                 )(wave, staged_F[2], staged_F[3])
             else:
-                per = jax.vmap(lambda wv: solve_one(m, C, wv))(wave)
+                per = jax.vmap(
+                    lambda wv: solve_one(m, C, wv, staged_dyn=staged_dyn)
+                )(wave)
             return case_reduce(per)
-        return solve_one(m, C, wave)
+        return solve_one(m, C, wave, staged_dyn=staged_dyn)
 
     return loss
 
@@ -208,6 +234,7 @@ def optimize_design(
     moor=None,
     moor_apply_fn=None,
     r6_moor=None,
+    bem_fn=None,
 ) -> OptResult:
     """Minimize a response statistic over a geometry parameterization.
 
@@ -241,7 +268,11 @@ def optimize_design(
     nominal hull and are held constant under differentiation — the gradient
     carries the statics/Morison/drag dependence on theta (the linearized-
     sweep convention; re-solving the panel method per step is what staging
-    avoids).
+    avoids).  With ``bem_fn``
+    (:func:`raft_tpu.hydro.jax_bem.make_bem_fn`) the panel solve runs
+    differentiably INSIDE each step instead: the gradient then carries
+    the full geometry -> A/B/F -> RAO chain — true potential-flow
+    co-design, at the cost of one on-device panel solve per step.
 
     Returns the parameter/objective trajectory so callers can inspect
     convergence rather than trust a single terminal value.
@@ -253,7 +284,8 @@ def optimize_design(
 
     loss = _make_loss(members, rna, env, wave, C_moor, objective, apply_fn,
                       bem, n_iter, remat, case_reduce=case_reduce,
-                      moor=moor, moor_apply_fn=moor_apply_fn, r6_moor=r6_moor)
+                      moor=moor, moor_apply_fn=moor_apply_fn, r6_moor=r6_moor,
+                      bem_fn=bem_fn)
     theta = jnp.asarray(theta0, dtype=float)
     # AOT registry: the value-and-grad step is ONE large executable reused
     # for every optimizer iteration AND across processes (warm co-design
@@ -273,7 +305,9 @@ def optimize_design(
                *(_cache.callable_salt(case_reduce)
                  if case_reduce is not None else ("case_reduce=max",)),
                *(_cache.callable_salt(moor_apply_fn)
-                 if moor_apply_fn is not None else ("moor_apply=none",))),
+                 if moor_apply_fn is not None else ("moor_apply=none",)),
+               *(_cache.callable_salt(bem_fn)
+                 if bem_fn is not None else ("bem_fn=none",))),
     )
     opt_state = optimizer.init(theta)
     history, thetas = [], [theta]

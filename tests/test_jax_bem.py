@@ -1,0 +1,335 @@
+"""On-device (JAX) BEM solver tests.
+
+Oracle: the native C++ f64 panel solver (``hydro/native_bem.py``), the
+spec the JAX port reproduces.  Parity is pinned at the DOCUMENTED
+tolerance (:data:`raft_tpu.hydro.jax_bem.PARITY_RTOL`) across the
+contract surface the tentpole claims: deep + finite depth, scalar heading
++ heading grid, with and without an irregular-frequency lid — plus a
+finite-difference check that ``jax.grad`` really flows through panel
+geometry, influence assembly and the refined LU solve.
+
+The cross-process side of the story (novel geometry with g++ POISONED,
+warm/novel zero-compile legs) lives in ``make bem-smoke``
+(:mod:`raft_tpu.hydro.bem_smoke`); these tests cover the numerics.
+"""
+import os
+
+import numpy as np
+import pytest
+
+from raft_tpu.hydro import jax_bem
+
+W = np.array([0.6, 1.1, 1.6])
+
+
+def column_mesh(r=1.2, draft=7.0, top=2.0, dz_max=1.5, da_max=1.2,
+                x0=0.0):
+    from raft_tpu.hydro.mesh import mesh_member
+
+    return mesh_member(
+        stations=[0.0, draft + top], diameters=[2 * r, 2 * r],
+        rA=[x0, 0.0, -draft], rB=[x0, 0.0, top],
+        dz_max=dz_max, da_max=da_max)
+
+
+def assert_parity(jax_out, native_out):
+    for g, n, name in zip(jax_out, native_out, ("A", "B", "F")):
+        err = jax_bem.parity_err(g, n)   # THE shared PARITY_RTOL metric
+        assert err <= jax_bem.PARITY_RTOL, (
+            f"{name}: {err:.2e} > PARITY_RTOL {jax_bem.PARITY_RTOL:.0e}")
+
+
+# ------------------------------------------------------------- mode knob
+
+def test_bem_mode_parsing(monkeypatch):
+    monkeypatch.delenv(jax_bem.ENV_VAR, raising=False)
+    assert jax_bem.bem_mode() == "auto"
+    for raw, want in [("native", "native"), (" JAX ", "jax"),
+                      ("auto", "auto"), ("", "auto"), ("bogus", "auto")]:
+        monkeypatch.setenv(jax_bem.ENV_VAR, raw)
+        assert jax_bem.bem_mode() == want
+    # auto resolves per backend: CPU suite -> the native host solver
+    monkeypatch.setenv(jax_bem.ENV_VAR, "auto")
+    assert jax_bem.resolved_mode() == "native"
+    assert jax_bem.resolved_mode("jax") == "jax"
+    assert jax_bem.resolved_mode("native") == "native"
+    # an EXPLICIT 'auto' (Model(BEM="auto")) defers to the env knob: the
+    # operator override must reach every Model, whatever mode string it
+    # was built with
+    monkeypatch.setenv(jax_bem.ENV_VAR, "jax")
+    assert jax_bem.resolved_mode("auto") == "jax"
+    monkeypatch.setenv(jax_bem.ENV_VAR, "native")
+    assert jax_bem.resolved_mode("auto") == "native"
+    monkeypatch.delenv(jax_bem.ENV_VAR)
+    assert jax_bem.resolved_mode("auto") == "native"   # backend rule (CPU)
+
+
+def test_mode_is_key_salted():
+    """A RAFT_TPU_BEM flip must change every AOT key (the staged
+    coefficients differ at parity tolerance, not bitwise)."""
+    from raft_tpu.cache.aot import _solver_salts
+
+    salts = _solver_salts()
+    assert "bem_mode" in salts
+    assert salts[salts.index("bem_mode") + 1] in ("native", "jax")
+
+
+def test_model_bem_arg_validated():
+    from raft_tpu.model import Model, load_design
+
+    design = load_design("raft_tpu/designs/OC3spar.yaml")
+    with pytest.raises(ValueError, match="expected 'native'"):
+        Model(design, BEM="typo-mode")
+
+
+def test_pad_panel_count_follows_ladder():
+    from raft_tpu.build import buckets
+
+    classes = buckets.ladder()["panels"]
+    assert jax_bem.pad_panel_count(1) == classes[0]
+    assert jax_bem.pad_panel_count(classes[0]) == classes[0]
+    assert jax_bem.pad_panel_count(classes[0] + 1) == classes[1]
+
+
+# --------------------------------------------------- shared result cache
+
+def test_cache_corrupt_counter(tmp_path, monkeypatch):
+    """A corrupt artifact is a COUNTED miss: ``bem.cache_corrupt``
+    increments (ChunkStore's ckpt.corrupt precedent), the file is
+    deleted, and the caller recomputes — corruption is observable, not a
+    silent unlink."""
+    from raft_tpu import obs
+    from raft_tpu.cache import config
+    from raft_tpu.hydro import native_bem
+
+    monkeypatch.setenv("RAFT_TPU_CACHE_DIR", str(tmp_path))
+    config.disable()                       # force env re-resolution
+    key = native_bem.result_cache_key(
+        "bem", np.zeros((2, 4, 3)), W, np.zeros(1), (1.0, 2.0))
+    corrupt0 = obs.metrics.counter("bem.cache_corrupt").value
+    miss0 = obs.metrics.counter("bem.cache_miss").value
+
+    # absent artifact: a plain miss, NOT corruption
+    assert native_bem.result_cache_load(key, ("A",)) is None
+    assert obs.metrics.counter("bem.cache_corrupt").value == corrupt0
+    assert obs.metrics.counter("bem.cache_miss").value == miss0 + 1
+
+    # garbage bytes: corrupt + miss, artifact deleted
+    os.makedirs(os.path.dirname(key), exist_ok=True)
+    with open(key, "wb") as f:
+        f.write(b"\x00not-an-npz")
+    assert native_bem.result_cache_load(key, ("A",)) is None
+    assert obs.metrics.counter("bem.cache_corrupt").value == corrupt0 + 1
+    assert not os.path.exists(key)
+
+    # whole npz MISSING a needed key: also corruption (torn contract)
+    native_bem.result_cache_store(key, {"B": np.ones(3)})
+    assert native_bem.result_cache_load(key, ("A", "B")) is None
+    assert obs.metrics.counter("bem.cache_corrupt").value == corrupt0 + 2
+    assert not os.path.exists(key)
+
+    # intact artifact: a hit, no further corruption counted
+    native_bem.result_cache_store(key, {"A": np.arange(3.0)})
+    out = native_bem.result_cache_load(key, ("A",))
+    np.testing.assert_array_equal(out["A"], np.arange(3.0))
+    assert obs.metrics.counter("bem.cache_corrupt").value == corrupt0 + 2
+
+
+def test_native_lib_keyed_by_source_content():
+    """The built .so is keyed by a CONTENT hash of bem.cpp — a git
+    checkout that regresses mtimes cannot serve a stale solver (the old
+    ``getmtime(_LIB) >= src_mtime`` check could)."""
+    from raft_tpu.hydro import native_bem
+
+    path = native_bem._lib_path()
+    digest = native_bem._src_digest()
+    assert digest[:16] in os.path.basename(path)
+    # the key is pure content: recomputing it is stable
+    assert native_bem._lib_path() == path
+
+
+# ------------------------------------------------------ parity vs oracle
+
+@pytest.mark.slow
+def test_parity_deep_scalar_heading():
+    from raft_tpu.hydro.native_bem import solve_bem
+
+    mesh = column_mesh()
+    kw = dict(rho=1025.0, g=9.81, beta=0.3, depth=0.0, cache=False)
+    native = solve_bem(mesh, W, **kw)
+    got = jax_bem.solve_bem_jax(mesh, W, **kw)
+    assert_parity(got, native)
+
+
+@pytest.mark.slow
+def test_parity_finite_depth_heading_grid():
+    """Finite depth (the 4-image exp-fit kernel) x a heading grid
+    (factor once, back-substitute per heading) — F comes back
+    (nb, 6, nw) on both paths."""
+    from raft_tpu.hydro.native_bem import solve_bem
+
+    mesh = column_mesh(r=1.1, draft=6.0)
+    betas = np.array([0.0, 0.7, 1.4])
+    kw = dict(rho=1025.0, g=9.81, beta=betas, depth=25.0, cache=False)
+    native = solve_bem(mesh, W, **kw)
+    got = jax_bem.solve_bem_jax(mesh, W, **kw)
+    assert got[2].shape == native[2].shape == (3, 6, len(W))
+    assert_parity(got, native)
+
+
+@pytest.mark.slow
+def test_parity_lid_mesh():
+    """Irregular-frequency lid (extended boundary integral): the lid
+    rows swap to the potential equation on both paths."""
+    from raft_tpu.hydro.mesh import disk_panels
+    from raft_tpu.hydro.native_bem import solve_bem
+
+    mesh = column_mesh(r=1.5, draft=7.0)
+    lid = disk_panels(np.zeros(3), 1.5, da_max=1.2)
+    assert len(lid) > 0
+    kw = dict(rho=1025.0, g=9.81, beta=0.0, depth=0.0, lid=lid,
+              cache=False)
+    native = solve_bem(mesh, W, **kw)
+    got = jax_bem.solve_bem_jax(mesh, W, **kw)
+    assert_parity(got, native)
+
+
+@pytest.mark.slow
+def test_residual_at_refinement_tolerance():
+    """The measured refinement residual (the f32-vs-oracle quality
+    signal the diagnostics return) sits at f32 roundoff, far inside the
+    parity tolerance."""
+    mesh = column_mesh(r=1.0, draft=5.0)
+    _, _, _, diag = jax_bem.solve_bem_jax(
+        mesh, W, beta=0.2, depth=30.0, cache=False,
+        return_diagnostics=True)
+    assert diag["refine_iters"] == jax_bem.N_REFINE
+    assert diag["max_residual"] < 1e-4
+    assert diag["padded"] >= diag["panels"]
+
+
+@pytest.mark.slow
+def test_solve_bem_any_routes_by_mode():
+    """Both routes honor the shared return contract and agree to the
+    parity tolerance — the staging sites can swap solver per knob."""
+    mesh = column_mesh(r=0.9, draft=4.5, dz_max=2.0, da_max=1.6)
+    kw = dict(rho=1025.0, g=9.81, beta=0.1, depth=0.0, cache=False)
+    a_nat = jax_bem.solve_bem_any(mesh, W, mode="native", **kw)
+    a_jax = jax_bem.solve_bem_any(mesh, W, mode="jax", **kw)
+    assert a_nat[0].shape == a_jax[0].shape == (6, 6, len(W))
+    assert a_nat[2].shape == a_jax[2].shape == (6, len(W))
+    assert_parity(a_jax, a_nat)
+
+
+@pytest.mark.slow
+def test_jax_result_cache_roundtrip(tmp_path, monkeypatch):
+    """The on-device solver shares the corruption-tolerant atomic result
+    cache: a second identical solve is served bit-identically from disk
+    (diagnostics say so), under the jax-specific namespace."""
+    from raft_tpu.cache import config
+
+    monkeypatch.setenv("RAFT_TPU_CACHE_DIR", str(tmp_path))
+    config.disable()
+    mesh = column_mesh(r=0.8, draft=4.0, dz_max=2.2, da_max=1.9)
+    w = np.array([0.9])
+    kw = dict(rho=1025.0, g=9.81, beta=0.0, depth=0.0, cache=True)
+    A1, B1, F1, d1 = jax_bem.solve_bem_jax(mesh, w, return_diagnostics=True,
+                                           **kw)
+    assert d1["cached"] is False
+    A2, B2, F2, d2 = jax_bem.solve_bem_jax(mesh, w, return_diagnostics=True,
+                                           **kw)
+    assert d2["cached"] is True
+    # ONE diagnostics contract on both paths: callers index the keys
+    # unconditionally, so a hit must carry them all (residual measured at
+    # store time rides in the artifact)
+    assert set(d2) == set(d1)
+    assert d2["padded"] == d1["padded"]
+    assert d2["max_residual"] == pytest.approx(d1["max_residual"])
+    np.testing.assert_array_equal(A1, A2)
+    np.testing.assert_array_equal(B1, B2)
+    np.testing.assert_array_equal(F1, F2)
+    assert os.path.isdir(os.path.join(str(tmp_path), "bem-jax"))
+
+
+# ------------------------------------------- differentiability (tentpole)
+
+@pytest.mark.slow
+def test_grad_matches_finite_difference():
+    """jax.grad through panel geometry -> influence assembly -> refined
+    LU solve -> A/B/F, against a central finite difference, in f64 (the
+    suite runs x64) so the FD truncation error is the only slack."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = column_mesh(r=1.2, draft=6.0, dz_max=2.2, da_max=1.9)
+    w = np.array([0.7, 1.2])
+    bem_fn = jax_bem.make_bem_fn(mesh, w, depth=30.0, beta=0.1,
+                                 dtype=jnp.float64)
+
+    def loss(theta):
+        A, B, F = bem_fn(theta)
+        return (jnp.sum(A) * 1e-6 + jnp.sum(B) * 1e-6
+                + jnp.sum(F.re ** 2 + F.im ** 2) * 1e-10)
+
+    loss_j = jax.jit(loss)
+    g = float(jax.jit(jax.grad(loss))(jnp.float64(1.0)))
+    eps = 1e-5
+    fd = (float(loss_j(jnp.float64(1.0 + eps)))
+          - float(loss_j(jnp.float64(1.0 - eps)))) / (2 * eps)
+    assert g == pytest.approx(fd, rel=1e-6)
+    assert np.isfinite(g) and abs(g) > 0.0
+
+
+@pytest.mark.slow
+def test_optimize_design_bem_fn_descends():
+    """The closed co-design loop: optimize_design(bem_fn=...) re-solves
+    the panel method differentiably inside each step, and the optimizer
+    still descends — the gradient carries geometry -> A/B/F -> RAO
+    (with a static ``bem`` the coefficients are frozen at the nominal
+    hull)."""
+    import jax.numpy as jnp
+
+    import __graft_entry__ as ge
+    from raft_tpu.mooring import mooring_stiffness, parse_mooring
+    from raft_tpu.parallel import optimize_design
+
+    design, members, rna, env, wave = ge._base(nw=24)
+    moor = parse_mooring(design["mooring"],
+                         yaw_stiffness=design["turbine"]["yaw_stiffness"])
+    C_moor = mooring_stiffness(moor, jnp.zeros(6))
+    # a coarse spar-like column (64-panel class keeps the re-solve cheap)
+    mesh = column_mesh(r=3.25, draft=8.0, dz_max=3.5, da_max=3.4)
+    assert jax_bem.pad_panel_count(len(mesh)) == 64
+    bem_fn = jax_bem.make_bem_fn(mesh, np.asarray(wave.w), beta=0.0,
+                                 dtype=jnp.float32)
+    res = optimize_design(members, rna, env, wave, C_moor, theta0=1.0,
+                          steps=2, learning_rate=0.02, bounds=(0.8, 1.25),
+                          n_iter=8, bem_fn=bem_fn)
+    assert np.isfinite(res.history).all()
+    assert res.history[-1] < res.history[0]
+    # exclusivity: frozen bem AND differentiable bem_fn cannot combine
+    with pytest.raises(ValueError, match="not both"):
+        optimize_design(members, rna, env, wave, C_moor, theta0=1.0,
+                        steps=1, bem=(np.zeros((6, 6, 24)),
+                                      np.zeros((6, 6, 24)),
+                                      np.zeros((6, 24), complex)),
+                        bem_fn=bem_fn)
+
+
+@pytest.mark.slow
+def test_grad_f32_stays_finite():
+    """The f32 production dtype: gradients through the padded mesh (with
+    degenerate zero-area panels) stay finite — the _safe_norm contract."""
+    import jax
+    import jax.numpy as jnp
+
+    mesh = column_mesh(r=1.0, draft=5.0, dz_max=2.2, da_max=1.9)
+    w = np.array([0.8])
+    bem_fn = jax_bem.make_bem_fn(mesh, w, beta=0.0, dtype=jnp.float32)
+
+    def loss(theta):
+        A, B, F = bem_fn(theta)
+        return jnp.sum(B) * 1e-6
+
+    g = float(jax.jit(jax.grad(loss))(jnp.float32(1.0)))
+    assert np.isfinite(g)

@@ -35,7 +35,7 @@ serve-smoke:     ## resident-daemon proof: compiles == buckets, solo parity, war
 	python -m raft_tpu.serve smoke   # restart 0 compiles; armed obs leg: request traces/SLO/flight/ledger
 
 bem-smoke:       ## on-device BEM proof: novel geometry solves with g++ POISONED
-	python -m raft_tpu.hydro.bem_smoke   # (no host solver), oracle parity, warm/novel zero compiles
+	python -m raft_tpu.hydro.bem_smoke   # (no host solver), oracle parity, warm/novel zero compiles; pallas-interpret leg: cross-route parity, zero compiles warm
 
 test:            ## full suite (nightly tier, ~35 min on one core)
 	python -m pytest tests/ -q

@@ -628,7 +628,120 @@ def hetero_buckets(nw: int = 64, n_iter: int = 30):
     }
 
 
-def bem_block(nw: int = 16, dz_max: float = 1.0, da_max: float = 0.9):
+def _cylinder_mesh(n_panels: int, radius: float, draft: float):
+    """A closed-bottom cylinder shell with EXACTLY ``n_panels`` panels
+    (``nth`` around x ``nz`` down the wall + ``nth`` bottom triangles),
+    used by the panels-ladder sweep to land precisely on each ``panels``
+    bucket class (``n_panels`` must be a multiple of 8)."""
+    nth = 8 if n_panels <= 256 else 16
+    nz = n_panels // nth - 1
+    th = np.linspace(0.0, 2 * np.pi, nth + 1)
+    zz = np.linspace(0.0, -draft, nz + 1)
+    pans = []
+    for i in range(nth):
+        a, b = th[i], th[i + 1]
+        for j in range(nz):
+            z0, z1 = zz[j], zz[j + 1]
+            pans.append([
+                [radius * np.cos(a), radius * np.sin(a), z0],
+                [radius * np.cos(b), radius * np.sin(b), z0],
+                [radius * np.cos(b), radius * np.sin(b), z1],
+                [radius * np.cos(a), radius * np.sin(a), z1]])
+        pans.append([[0.0, 0.0, -draft],
+                     [radius * np.cos(b), radius * np.sin(b), -draft],
+                     [radius * np.cos(a), radius * np.sin(a), -draft],
+                     [0.0, 0.0, -draft]])
+    assert len(pans) == n_panels, (len(pans), n_panels)
+    return np.asarray(pans)
+
+
+def _bem_ladder(sizes, nw: int, kw: dict, budget_s: float):
+    """The panels-ladder sweep of :func:`bem_block`: per bucket class,
+    panel rows/s and staging seconds for native host vs jax-XLA vs
+    jax-pallas.  All legs cache-cold; each jax route pays its compile on
+    a first geometry, then a same-class NOVEL geometry (never seen by
+    any cache) gives the warm rows/s — the per-(route, panels) roofline
+    the ledger wants.  Wall-clock guarded: before each leg the cost is
+    extrapolated cubically from the last completed size of the same
+    route, and legs that would blow the remaining budget are recorded as
+    ``skipped`` (honest truncation beats a driver timeout).
+    """
+    import jax
+
+    from raft_tpu.hydro import jax_bem
+    from raft_tpu.hydro.native_bem import solve_bem
+
+    t_start = time.perf_counter()
+    w = np.linspace(0.3, 1.8, nw)
+    backend = jax.default_backend()
+    routes = ("native", "jax_xla", "jax_pallas")
+    last: dict = {}      # route -> (panels, measured leg seconds)
+    entries: dict = {}
+
+    def remaining():
+        return budget_s - (time.perf_counter() - t_start)
+
+    for n in sizes:
+        ent: dict = {}
+        for route in routes:
+            prev = last.get(route)
+            if prev is not None:
+                est = prev[1] * (n / prev[0]) ** 3
+                if est > remaining():
+                    ent[route] = {"skipped":
+                                  f"extrapolated ~{est:.0f}s > "
+                                  f"{max(remaining(), 0.0):.0f}s budget left"}
+                    continue
+            try:
+                if route == "native":
+                    t0 = time.perf_counter()
+                    solve_bem(_cylinder_mesh(n, 1.41, 8.3), w, **kw)
+                    dt = time.perf_counter() - t0
+                    ent[route] = {
+                        "solve_s": round(dt, 3),
+                        "rows_per_s": round(n * nw / max(dt, 1e-9), 1)}
+                else:
+                    asm = "xla" if route == "jax_xla" else "pallas"
+                    t0 = time.perf_counter()
+                    jax_bem.solve_bem_jax(
+                        _cylinder_mesh(n, 1.41, 8.3), w, assembly=asm, **kw)
+                    cold = time.perf_counter() - t0
+                    t0 = time.perf_counter()
+                    _, _, _, diag = jax_bem.solve_bem_jax(
+                        _cylinder_mesh(n, 1.37, 7.9), w, assembly=asm,
+                        return_diagnostics=True, **kw)
+                    dt = time.perf_counter() - t0
+                    ent[route] = {
+                        "staging_s": round(cold, 3),
+                        "solve_s": round(dt, 3),
+                        "rows_per_s": round(n * nw / max(dt, 1e-9), 1),
+                        "max_residual": float(diag["max_residual"])}
+            except Exception as e:                    # honest partial sweep
+                ent[route] = {"error":
+                              f"{type(e).__name__}: {str(e)[-200:]}"}
+                continue
+            last[route] = (n, max(dt, 1e-3))
+        rps = {r: ent[r].get("rows_per_s") for r in routes}
+        if rps["jax_pallas"]:
+            ent["pallas_beats_xla"] = bool(
+                rps["jax_xla"] and rps["jax_pallas"] > rps["jax_xla"])
+            ent["pallas_beats_native"] = bool(
+                rps["native"] and rps["jax_pallas"] > rps["native"])
+        entries[str(n)] = ent
+    return {
+        "sizes": [int(s) for s in sizes],
+        "nw": nw,
+        "budget_s": budget_s,
+        "backend": backend,
+        # honest-label clause: off-TPU the pallas route runs the Pallas
+        # INTERPRETER (numerics-exact, not performance-representative)
+        "pallas_interpreted": backend != "tpu",
+        "entries": entries,
+    }
+
+
+def bem_block(nw: int = 16, dz_max: float = 1.0, da_max: float = 0.9,
+              ladder_sizes=(128, 512, 2048), ladder_budget_s: float = 600.0):
     """The ``bem`` bench block: novel-geometry BEM staging, native host
     vs on-device (``workloads.bem`` -> ``bench.bem`` in EVIDENCE.json).
 
@@ -647,7 +760,10 @@ def bem_block(nw: int = 16, dz_max: float = 1.0, da_max: float = 0.9):
       THE novel-geometry cost the tentpole removes.
 
     Parity vs the f64 oracle and the refinement residual ride along so
-    the speedup is never quoted without its accuracy bill.
+    the speedup is never quoted without its accuracy bill.  The
+    ``ladder`` sub-block (:func:`_bem_ladder`) extends the claim
+    per-size: rows/s and staging seconds for native vs jax-XLA vs
+    jax-pallas at each ``panels`` bucket class.
     """
     from raft_tpu.hydro import jax_bem
     from raft_tpu.hydro.bem_smoke import novel_mesh
@@ -695,6 +811,7 @@ def bem_block(nw: int = 16, dz_max: float = 1.0, da_max: float = 0.9):
         "parity_rtol": jax_bem.PARITY_RTOL,
         "parity_ok": bool(all(v <= jax_bem.PARITY_RTOL
                               for v in parity.values())),
+        "ladder": _bem_ladder(ladder_sizes, nw, kw, ladder_budget_s),
     }
 
 
@@ -1208,9 +1325,13 @@ def main():
             # novel-geometry BEM staging: native host vs on-device (the
             # jax_bem staging-cliff claim; reduced mesh on CPU fallback)
             try:
+                # CPU fallback: reduced mesh, small w grid, and a ladder
+                # truncated to the classes the interpreter can afford
                 bem = bem_block(**({} if not fallback else
                                    {"nw": 6, "dz_max": 1.6,
-                                    "da_max": 1.3}))
+                                    "da_max": 1.3,
+                                    "ladder_sizes": (64, 128),
+                                    "ladder_budget_s": 240.0}))
             except Exception as e:
                 bem = {"error": f"{type(e).__name__}: {str(e)[-300:]}"}
         pallas = None

@@ -55,9 +55,19 @@ DEFAULT_LADDER: dict = {
     # BEM panel-mesh size classes (hull + lid panels = the influence-
     # matrix dimension of hydro/jax_bem.py): padded with degenerate
     # zero-area panels so every mesh of a class shares one compiled
-    # on-device solve — same contract as the member axes above
+    # on-device solve — same contract as the member axes above.  Every
+    # built-in class is a BEM_TILE multiple, so the tiled Pallas
+    # assembly route (core/pallas_bem.py) engages for all of them; a
+    # custom RAFT_TPU_BUCKETS override with a non-multiple class still
+    # works (that class just falls back to the XLA assembly route).
     "panels": (64, 128, 256, 512, 768, 1024, 1536, 2048),
 }
+
+#: (panel_i, panel_j) tile edge of the Pallas BEM assembly kernels — the
+#: influence-matrix grid is (n / BEM_TILE)^2 tiles with the wave-integral
+#: tables VMEM-resident per tile.  The built-in ``panels`` ladder above is
+#: aligned to it by construction.
+BEM_TILE = 64
 
 _AXES = tuple(DEFAULT_LADDER)
 

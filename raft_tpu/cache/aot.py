@@ -309,7 +309,8 @@ def _consts_fingerprint(consts) -> str:
 def _solver_salts() -> tuple:
     """Runtime knobs that change the traced/compiled program without
     appearing in any argument: the Pallas kernel routing, the BEM solver
-    routing, x64 mode, matmul precision, and raw XLA flags.  Keyed
+    routing (mode, assembly route, assembly precision), x64 mode, matmul
+    precision, and raw XLA flags.  Keyed
     centrally so no call site can forget them — JAX's persistent compile
     cache keys on its compile options, and the AOT layer must not bypass
     that protection.  (RAFT_TPU_BEM changes which solver produced the
@@ -323,6 +324,8 @@ def _solver_salts() -> tuple:
 
     return ("pallas", bool(pallas6.enabled()),
             "bem_mode", jax_bem.resolved_mode(),
+            "bem_assembly", jax_bem.resolved_assembly(),
+            "bem_precision", jax_bem.bem_precision(),
             "x64", bool(jax.config.jax_enable_x64),
             "matmul", str(getattr(jax.config, "jax_default_matmul_precision",
                                   None)),

@@ -27,14 +27,34 @@ Kernels:
                          reference solveEigen, raft/raft.py:1394).
   * :func:`cholesky`   — unrolled Cholesky for SPD mass matrices.
   * :func:`generalized_eigh` — K x = lambda M x via Cholesky + Jacobi.
+
+Large-matrix pure-jnp LU (the BEM 2n x 2n real panel systems — see the
+pointer-portability note in :mod:`raft_tpu.hydro.jax_bem`: LAPACK custom
+calls embed process-local pointers, so AOT-portable factorization must be
+plain HLO):
+
+  * :func:`lu_factor_unblocked` / :func:`lu_solve_unblocked` — the
+    row-by-row scan (one rank-1 update per row), the bit-level reference.
+  * :func:`lu_factor_blocked` / :func:`lu_solve_blocked` — blocked
+    right-looking LU with partial pivoting: panel factorization with the
+    pivot search over the FULL trailing column (so the pivot sequence
+    matches the unblocked factorization up to roundoff ties), then one
+    (m x b) @ (b x m) GEMM trailing update per panel — the O(m) rank-1
+    latency chain collapses to O(m / b) GEMMs the MXU can saturate.
 """
 from __future__ import annotations
 
 import jax.numpy as jnp
+from jax import lax
 
 from raft_tpu.core.cplx import Cx
 
 Array = jnp.ndarray
+
+#: default panel width of the blocked LU: wide enough that the trailing
+#: GEMM dominates, small enough that the unrolled in-panel elimination
+#: keeps trace size bounded (b unrolled steps per scanned panel)
+LU_BLOCK = 32
 
 
 def _pivot_rows(col_mag: Array, k: int, n: int):
@@ -236,3 +256,206 @@ def generalized_eigh(K: Array, M: Array, n: int = 6, sweeps: int = 12):
     # modes: x = L^-T v
     X = solve_upper(jnp.swapaxes(L, -1, -2), V, n=n)
     return lam, X
+
+
+# ------------------------------------------------- large-matrix pure-jnp LU
+#
+# The BEM panel systems (2n x 2n real, n up to 2048) need a factorization
+# that serializes as plain HLO (no LAPACK custom calls — those embed
+# process-local pointers and segfault on warm AOT deserialization) and
+# stays vmap-able for frequency batching.  The unblocked scan is the
+# reference; the blocked variant is the hot path.
+
+
+def _ceil_to(m: int, b: int) -> int:
+    return -(-m // b) * b
+
+
+def _pad_identity(A: Array, mp: int) -> Array:
+    """Embed (m, m) A in an (mp, mp) matrix with 1s on the padded diagonal.
+
+    Padded rows/columns never interact with the real block under partially
+    pivoted elimination: a padded row is all-zero in every real column (so
+    it never wins a pivot search — argmax ties resolve to the first, i.e.
+    real, candidate), and each padded column's only nonzero is its unit
+    diagonal (so its pivot is itself and its multipliers are zero).
+    """
+    m = A.shape[0]
+    out = jnp.zeros((mp, mp), A.dtype).at[:m, :m].set(A)
+    pad = jnp.arange(m, mp)
+    return out.at[pad, pad].set(1.0)
+
+
+def lu_factor_unblocked(A: Array):
+    """Row-by-row LU with partial pivoting: (LU, perm) in the LAPACK
+    getrf layout (unit-L strictly below the diagonal, U on/above).
+
+    One pivot search + rank-1 update per row — an O(m) sequential chain
+    of O(m^2) updates.  Kept as the bit-level reference the blocked
+    factorization is pinned against (tests/test_bem_tiles.py)."""
+    m = A.shape[0]
+    idx = jnp.arange(m)
+
+    def step(carry, k):
+        A, perm = carry
+        col = A[:, k]
+        mag = jnp.where(idx >= k, jnp.abs(col), -1.0)
+        p = jnp.argmax(mag)
+        rowk, rowp = A[k], A[p]
+        A = A.at[k].set(rowp).at[p].set(rowk)
+        pk, pp = perm[k], perm[p]
+        perm = perm.at[k].set(pp).at[p].set(pk)
+        piv = A[k, k]
+        piv = jnp.where(jnp.abs(piv) > 1e-30, piv, 1e-30)
+        f = jnp.where(idx > k, A[:, k] / piv, 0.0)
+        rowk_u = jnp.where(idx >= k, A[k], 0.0)     # U part of the pivot row
+        A = A - jnp.outer(f, rowk_u)
+        A = A.at[:, k].set(jnp.where(idx > k, f, A[:, k]))
+        return (A, perm), None
+
+    (LU, perm), _ = lax.scan(step, (A, idx), jnp.arange(m))
+    return LU, perm
+
+
+def lu_solve_unblocked(LU: Array, perm: Array, B: Array) -> Array:
+    """Forward/back substitution for all RHS columns at once (reference
+    twin of :func:`lu_solve_blocked`)."""
+    m = LU.shape[0]
+    idx = jnp.arange(m)
+    X = B[perm]
+
+    def fwd(k, X):
+        lk = jnp.where(idx < k, LU[k], 0.0)
+        return X.at[k].add(-(lk @ X))
+
+    X = lax.fori_loop(0, m, fwd, X)
+
+    def bwd(i, X):
+        k = m - 1 - i
+        uk = jnp.where(idx > k, LU[k], 0.0)
+        dk = LU[k, k]
+        dk = jnp.where(jnp.abs(dk) > 1e-30, dk, 1e-30)
+        return X.at[k].set((X[k] - uk @ X) / dk)
+
+    return lax.fori_loop(0, m, bwd, X)
+
+
+def lu_factor_blocked(A: Array, block: int = LU_BLOCK):
+    """Blocked right-looking LU with partial pivoting, pure jnp.
+
+    Same layout and (up to roundoff ties) same pivot sequence as
+    :func:`lu_factor_unblocked`: each b-column panel is factored with the
+    pivot search over the full trailing column height, the recorded swaps
+    are replayed on the rest of the matrix, the U12 block-row is solved
+    with the panel's unit-lower L11, and the trailing submatrix takes ONE
+    (m x b) @ (b x m) masked GEMM update — so the sequential chain is
+    m / b GEMM steps instead of m rank-1 updates.  Shapes not divisible
+    by ``block`` are identity-padded internally (see
+    :func:`_pad_identity`) and sliced back, so any m is accepted.
+    """
+    m = A.shape[0]
+    mp = _ceil_to(m, block)
+    if mp != m:
+        A = _pad_identity(A, mp)
+    idx = jnp.arange(mp)
+    nb = mp // block
+    cols = jnp.arange(block)
+
+    def factor_panel(carry, kb):
+        A, perm = carry
+        k0 = kb * block
+        P = lax.dynamic_slice(A, (0, k0), (mp, block))
+        swaps = []
+        for j in range(block):                      # static unroll: b steps
+            kg = k0 + j
+            mag = jnp.where(idx >= kg, jnp.abs(P[:, j]), -1.0)
+            p = jnp.argmax(mag)
+            rowk, rowp = P[kg], P[p]
+            P = P.at[kg].set(rowp).at[p].set(rowk)
+            swaps.append((kg, p))
+            piv = P[kg, j]
+            piv = jnp.where(jnp.abs(piv) > 1e-30, piv, 1e-30)
+            f = jnp.where(idx > kg, P[:, j] / piv, 0.0)
+            rowu = jnp.where(cols >= j, P[kg], 0.0)
+            P = P - jnp.outer(f, rowu)
+            P = P.at[:, j].set(jnp.where(idx > kg, f, P[:, j]))
+        # replay the panel's swaps on the full matrix (previous L columns
+        # AND trailing columns; the panel columns are overwritten below)
+        for kg, p in swaps:
+            rowk, rowp = A[kg], A[p]
+            A = A.at[kg].set(rowp).at[p].set(rowk)
+            pk, pp = perm[kg], perm[p]
+            perm = perm.at[kg].set(pp).at[p].set(pk)
+        A = lax.dynamic_update_slice(A, P, (0, k0))
+        # U12 block-row: L11 U12 = A12 (unit-lower solve across the full
+        # width, committed only on the trailing columns)
+        L11 = lax.dynamic_slice(A, (k0, k0), (block, block))
+        row = lax.dynamic_slice(A, (k0, 0), (block, mp))
+        solved = row
+        for r in range(1, block):
+            solved = solved.at[r].add(-(L11[r, :r] @ solved[:r]))
+        trail = idx >= k0 + block                   # (mp,) column mask
+        row = jnp.where(trail[None, :], solved, row)
+        A = lax.dynamic_update_slice(A, row, (k0, 0))
+        # trailing GEMM update: A22 -= L21 @ U12 (masks make rows above
+        # the panel and columns left of the trailing block no-ops)
+        Lcol = lax.dynamic_slice(A, (0, k0), (mp, block))
+        Lcol = jnp.where(trail[:, None], Lcol, 0.0)
+        Urow = jnp.where(trail[None, :], row, 0.0)
+        A = A - Lcol @ Urow
+        return (A, perm), None
+
+    (LU, perm), _ = lax.scan(factor_panel, (A, idx), jnp.arange(nb))
+    return LU[:m, :m], perm[:m]
+
+
+def lu_solve_blocked(LU: Array, perm: Array, B: Array,
+                     block: int = LU_BLOCK) -> Array:
+    """Blocked forward/back substitution for all RHS columns at once:
+    per b-row block, an unrolled in-block triangular solve plus one
+    (m x b) @ (b x nrhs) masked GEMM propagating it to the remaining
+    rows.  Accepts any m (identity-padded internally like the factor)."""
+    m = LU.shape[0]
+    vec = B.ndim == 1
+    if vec:
+        B = B[:, None]
+    mp = _ceil_to(m, block)
+    if mp != m:
+        LU = _pad_identity(LU, mp)
+        perm = jnp.concatenate([perm, jnp.arange(m, mp)])
+        B = jnp.concatenate(
+            [B, jnp.zeros((mp - m, B.shape[1]), B.dtype)], axis=0)
+    nrhs = B.shape[1]
+    idx = jnp.arange(mp)
+    nb = mp // block
+    X = B[perm]
+
+    def fwd(X, kb):
+        k0 = kb * block
+        Lb = lax.dynamic_slice(LU, (k0, k0), (block, block))
+        Xb = lax.dynamic_slice(X, (k0, 0), (block, nrhs))
+        for r in range(1, block):
+            Xb = Xb.at[r].add(-(Lb[r, :r] @ Xb[:r]))
+        X = lax.dynamic_update_slice(X, Xb, (k0, 0))
+        Lcol = lax.dynamic_slice(LU, (0, k0), (mp, block))
+        Lcol = jnp.where((idx >= k0 + block)[:, None], Lcol, 0.0)
+        return X - Lcol @ Xb, None
+
+    X, _ = lax.scan(fwd, X, jnp.arange(nb))
+
+    def bwd(X, i):
+        k0 = (nb - 1 - i) * block
+        Ub = lax.dynamic_slice(LU, (k0, k0), (block, block))
+        Xb = lax.dynamic_slice(X, (k0, 0), (block, nrhs))
+        for r in range(block - 1, -1, -1):
+            d = Ub[r, r]
+            d = jnp.where(jnp.abs(d) > 1e-30, d, 1e-30)
+            Xb = Xb.at[r].set((Xb[r] - Ub[r, r + 1:] @ Xb[r + 1:]) / d)
+        X = lax.dynamic_update_slice(X, Xb, (k0, 0))
+        Ucol = lax.dynamic_slice(LU, (0, k0), (mp, block))
+        Ucol = jnp.where((idx < k0)[:, None], Ucol, 0.0)
+        return X - Ucol @ Xb, None
+
+    X, _ = lax.scan(bwd, X, jnp.arange(nb))
+    X = X[:m]
+    return X[:, 0] if vec else X

@@ -1,0 +1,390 @@
+"""Pallas-tiled BEM influence-matrix assembly (the panel-solve hot path).
+
+The JAX BEM port (:mod:`raft_tpu.hydro.jax_bem`) assembles two dense
+(panels x panels) interaction stages per solve: the frequency-independent
+Rankine direct+image quadrature (a scan over ~760 subdivision points,
+each step one (n, n) broadcast op) and the per-frequency wave part (the
+tabulated PV integrals I0/I1, bilinear in f32, plus Bessel asymptotics).
+Under XLA each scan step round-trips its (n, n) working set through HBM;
+at n = 2048 that is ~16 MB per step, hundreds of times.
+
+This module is the same math as two hand-tiled Pallas kernels over
+(panel_i, panel_j) tiles of edge :data:`TILE` (= ``buckets.BEM_TILE``,
+the built-in panels-ladder alignment):
+
+* :func:`rankine_assembly` — the full subdivision-point loop runs per
+  tile with the (TILE, TILE) accumulators VMEM-resident, and the eight
+  (n, n[, 3]) direct/image potential+gradient outputs of the XLA path
+  collapse to the TWO matrices the solve actually consumes:
+  ``R_pot = pot_d + pot_i`` and ``R_dn = (grad_d + grad_i) . n_i``.
+* :func:`wave_assembly` — one frequency's wave part + combine: the
+  wave-integral tables (~720 KB f32 each) are resident in VMEM for
+  every tile, and the tile emits the assembled ``S``/``Dn`` blocks
+  directly, so no wave-part intermediate ever exists in HBM.  Batched
+  over a frequency chunk via ``jax.vmap`` (the ``pallas_call`` batching
+  rule turns the batch into a leading grid axis; per-frequency scalars
+  ride as (1, 1) operands, so the finite-depth ``lax.cond`` stays a
+  real branch per grid step instead of vmap's both-sides ``select``).
+
+Both kernels call the SAME region-split helpers as the XLA route
+(``eval_wave_integrals`` / ``_wave_deep`` / ``_wave_fd`` / the level
+selectors), imported lazily from :mod:`raft_tpu.hydro.jax_bem` — the
+routes share one numerical definition and differ only in tiling, which
+is what makes the interpret-mode cross-path parity pin
+(tests/test_bem_tiles.py, 1e-4 — the PR 3 precedent) meaningful.
+
+Route selection lives in :func:`jax_bem.resolved_assembly` (the
+key-salted ``RAFT_TPU_BEM_ASSEMBLY`` knob, auto = pallas iff TPU); the
+XLA path remains the fallback for non-``TILE``-aligned custom ladders
+and for every differentiated trace (these kernels carry no AD rules —
+the geometry co-design hook pins ``assembly="xla"``).  On non-TPU
+backends the kernels run in interpreter mode (CPU tests/smoke); the
+table bilinear gather is the documented Mosaic caveat to re-validate on
+hardware, per the honest-reporting precedent.
+"""
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+
+from raft_tpu.build.buckets import BEM_TILE as TILE
+
+Array = jnp.ndarray
+
+#: documented XLA-vs-pallas cross-route agreement bound (scale-relative
+#: max |pallas - xla|, the PR 3 interpret-parity precedent): the routes
+#: share one numerical definition, so only summation association and
+#: fused-multiply contraction differ.  Pinned by tests/test_bem_tiles.py
+#: and the bem-smoke pallas leg.
+INTERP_PARITY_RTOL = 1e-4
+
+
+def tile_ok(n: int) -> bool:
+    """True when an n-panel padded mesh divides into whole tiles (every
+    built-in panels-ladder class does; custom ladders may not — those
+    classes use the XLA assembly route)."""
+    return n >= TILE and n % TILE == 0
+
+
+def _interpret_default() -> bool:
+    try:
+        return jax.default_backend() != "tpu"
+    except Exception:
+        return True
+
+
+def _gl_rows(dtype):
+    """The 16-point Gauss-Legendre nodes of the near quadrature as
+    (1, 16) operand rows (kernels may not capture constant arrays)."""
+    from raft_tpu.hydro import jax_bem as _jb
+
+    return (jnp.asarray(_jb._GL16_X, dtype)[None, :],
+            jnp.asarray(_jb._GL16_W, dtype)[None, :])
+
+
+def _quad_stack(quads):
+    """Host quad constants -> (1, NQ) device rows (u, v, weight, level)."""
+    import numpy as np
+
+    us = np.concatenate([q[0] for q in quads])[None, :]
+    vs = np.concatenate([q[1] for q in quads])[None, :]
+    wf = np.concatenate([q[2] for q in quads])[None, :]
+    lv = np.concatenate([q[3] for q in quads])[None, :]
+    return us, vs, wf, lv
+
+
+# ------------------------------------------------------- Rankine kernel
+
+
+def _rankine_kernel(nq_main: int, nq_fine: int,
+                    pans_ref, ci_ref, ni_ref, cj_ref, area_ref, diag_ref,
+                    mask_ref, lids_ref, spot_ref, rid_ref, cid_ref,
+                    us_ref, vs_ref, wf_ref, lv_ref,
+                    pot_ref, dn_ref):
+    """One (TILE, TILE) tile of the Rankine direct+image quadrature.
+
+    Field side (i): centroids + unit normals.  Source side (j): panel
+    vertices, centroid, area, diagonal, masks, exact self potential.
+    The subdivision-point loop is two ``fori_loop``s (main levels carry
+    direct + image, the fine ns=24 level is image-only — the native
+    level split), with global row/column ids supplied as data so the
+    kernel is insensitive to grid-axis numbering (vmap prepends one).
+    """
+    from raft_tpu.hydro import jax_bem as _jb
+
+    dtype = ci_ref.dtype
+    ci = ci_ref[...]                       # (T, 3)
+    ni = ni_ref[...]                       # (T, 3)
+    cj = cj_ref[...]                       # (T, 3)
+    pans = pans_ref[...]                   # (T, 4, 3)
+    area = area_ref[0, :]                  # (T,)
+    diag = diag_ref[0, :]
+    mask = mask_ref[0, :]
+    lids = lids_ref[0, :] > 0.5            # lid-at-surface flag (source)
+    spot = spot_ref[0, :]                  # exact self potential
+    eye = rid_ref[0, :][:, None] == cid_ref[0, :][None, :]
+
+    def zflip(p):
+        # free-surface image: negate z (built by stacking — a (3,) sign
+        # vector would be a captured constant, which kernels reject)
+        return jnp.stack([p[:, 0], p[:, 1], -p[:, 2]], axis=-1)
+
+    d0 = ci[:, None, :] - cj[None, :, :]
+    dist = jnp.sqrt(jnp.sum(d0 * d0, axis=-1) + 1e-20)
+    dI = ci[:, None, :] - zflip(cj)[None, :, :]
+    distI = jnp.sqrt(jnp.sum(dI * dI, axis=-1) + 1e-20)
+    diag_safe = jnp.where(diag > 1e-9, diag, 1.0)
+    rel = jnp.where(diag > 1e-9, dist / diag_safe[None, :], 1e9)
+    relI = jnp.where(diag > 1e-9, distI / diag_safe[None, :], 1e9)
+    sel_d = _jb._level_select_direct(rel)
+    sel_i = _jb._level_select_image(relI)
+    # diagonal: exact direct self term (sentinel -1 drops the numeric
+    # one); image diagonal stays numeric except lid panels AT z = 0
+    sel_d = jnp.where(eye, -1, sel_d)
+    sel_i = jnp.where(eye & lids[None, :], -1, sel_i)
+
+    def contrib(pt, dA, sel, lv):
+        d = ci[:, None, :] - pt[None, :, :]
+        r2 = jnp.sum(d * d, axis=-1)
+        ok = (sel == lv) & (r2 > 1e-12)
+        r2s = jnp.where(ok, r2, 1.0)
+        ir = 1.0 / jnp.sqrt(r2s)
+        ir3 = ir / r2s
+        pot = jnp.where(ok, dA[None, :] * ir, 0.0)
+        dsn = (d[:, :, 0] * ni[:, 0][:, None] + d[:, :, 1]
+               * ni[:, 1][:, None] + d[:, :, 2] * ni[:, 2][:, None])
+        return pot, jnp.where(ok, -dA[None, :] * ir3, 0.0) * dsn
+
+    def point(q):
+        u = us_ref[0, q]
+        v = vs_ref[0, q]
+        pt = ((1 - u) * (1 - v) * pans[:, 0] + u * (1 - v) * pans[:, 1]
+              + u * v * pans[:, 2] + (1 - u) * v * pans[:, 3])
+        return pt, area * wf_ref[0, q], lv_ref[0, q]
+
+    def body_main(q, carry):
+        pot, dn = carry
+        pt, dA, lv = point(q)
+        p, g = contrib(pt, dA, sel_d, lv)
+        pot, dn = pot + p, dn + g
+        p, g = contrib(zflip(pt), dA, sel_i, lv)
+        return pot + p, dn + g
+
+    def body_fine(q, carry):
+        pot, dn = carry
+        pt, dA, lv = point(q)
+        p, g = contrib(zflip(pt), dA, sel_i, lv)
+        return pot + p, dn + g
+
+    zero = jnp.zeros((ci.shape[0], cj.shape[0]), dtype)
+    pot, dn = lax.fori_loop(0, nq_main, body_main, (zero, zero))
+    pot, dn = lax.fori_loop(nq_main, nq_main + nq_fine, body_fine,
+                            (pot, dn))
+    # exact self potential on the diagonal (doubled for a lid panel at
+    # z = 0, whose free-surface image is itself)
+    pot = pot + jnp.where(eye, spot[None, :]
+                          * (1.0 + jnp.where(lids, 1.0, 0.0))[None, :], 0.0)
+    colm = mask[None, :]
+    pot_ref[...] = pot * colm
+    dn_ref[...] = dn * colm
+
+
+def rankine_assembly(pans, c, nrm, area, diag, panel_mask, lid_surface,
+                     self_pot, *, interpret: bool | None = None):
+    """Tiled Rankine assembly: ``(R_pot, R_dn)`` with
+    ``R_pot = pot_d + pot_i`` and ``R_dn = (grad_d + grad_i) . n_i`` —
+    exactly the two (n, n) matrices the per-frequency combine consumes
+    (the XLA route's eight pot/grad outputs, pre-collapsed in VMEM)."""
+    from raft_tpu.hydro import jax_bem as _jb
+
+    n = pans.shape[0]
+    if not tile_ok(n):
+        raise ValueError(f"panel count {n} not a {TILE} multiple; "
+                         f"use the XLA assembly route")
+    dtype = c.dtype
+    interpret = _interpret_default() if interpret is None else interpret
+    g = n // TILE
+
+    usm, vsm, wfm, lvm = _quad_stack((_jb._QUAD_MAIN, _jb._QUAD_FINE))
+    nq_main = _jb._QUAD_MAIN[0].shape[0]
+    nq_fine = _jb._QUAD_FINE[0].shape[0]
+    ids = jnp.arange(n, dtype=jnp.int32)[None, :]
+    row1 = lambda x: jnp.asarray(x, dtype).reshape(1, n)
+
+    full = lambda shape: pl.BlockSpec(shape, lambda i, j: (0,) * len(shape))
+    irow = pl.BlockSpec((1, TILE), lambda i, j: (0, i))
+    jrow = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
+    out = pl.BlockSpec((TILE, TILE), lambda i, j: (i, j))
+
+    kernel = functools.partial(_rankine_kernel, nq_main, nq_fine)
+    nq = nq_main + nq_fine
+    R_pot, R_dn = pl.pallas_call(
+        kernel,
+        grid=(g, g),
+        in_specs=[
+            pl.BlockSpec((TILE, 4, 3), lambda i, j: (j, 0, 0)),   # pans_j
+            pl.BlockSpec((TILE, 3), lambda i, j: (i, 0)),         # c_i
+            pl.BlockSpec((TILE, 3), lambda i, j: (i, 0)),         # nrm_i
+            pl.BlockSpec((TILE, 3), lambda i, j: (j, 0)),         # c_j
+            jrow, jrow, jrow, jrow, jrow,      # area, diag, mask, lids, spot
+            irow, jrow,                        # row ids, col ids
+            full((1, nq)), full((1, nq)), full((1, nq)), full((1, nq)),
+        ],
+        out_specs=(out, out),
+        out_shape=(jax.ShapeDtypeStruct((n, n), dtype),
+                   jax.ShapeDtypeStruct((n, n), dtype)),
+        interpret=interpret,
+    )(
+        pans, c, nrm, c,
+        row1(area), row1(diag), row1(panel_mask),
+        row1(jnp.where(lid_surface, 1.0, 0.0)), row1(self_pot),
+        ids, ids,
+        jnp.asarray(usm, dtype), jnp.asarray(vsm, dtype),
+        jnp.asarray(wfm, dtype), jnp.asarray(lvm),
+    )
+    return R_pot, R_dn
+
+
+# --------------------------------------------------------- wave kernel
+
+
+def _wave_kernel(finite_depth: bool, depth: float,
+                 Rp_ref, Rdn_ref, ci_ref, ni_ref, cj_ref, area_ref,
+                 mask_ref, lids_ref, rid_ref, cid_ref, i0_ref, i1_ref,
+                 glx_ref, glw_ref, k_ref, k0_ref, A0_ref, act_ref,
+                 lam_ref, a_ref,
+                 sre_ref, sim_ref, dre_ref, dim_ref):
+    """One (TILE, TILE) tile of one frequency's wave part + combine.
+
+    Emits the assembled S (source-potential) and Dn (normal-derivative)
+    blocks; the -2 pi diagonal shift and the lid-row equation swap are
+    O(n^2) elementwise and stay outside (shared with the XLA route).
+    The wave-integral tables are whole-array VMEM residents; for finite
+    depth the deep-vs-4-image choice is a real scalar ``lax.cond`` per
+    grid step (``active`` rides in as a (1, 1) operand).
+    """
+    from raft_tpu.hydro import jax_bem as _jb
+
+    ci = ci_ref[...]
+    ni = ni_ref[...]
+    cj = cj_ref[...]
+    area = area_ref[0, :]                  # (T,)
+    colm = mask_ref[0, :][None, :]
+    # the near-quadrature GL nodes ride in as operands ("nodes" key —
+    # see eval_wave_integrals), since kernels may not capture constants
+    tab = {"I0": i0_ref[...], "I1": i1_ref[...],
+           "nodes": (glx_ref[0, :], glw_ref[0, :])}
+    eye = rid_ref[0, :][:, None] == cid_ref[0, :][None, :]
+    diag_lid = eye & (lids_ref[0, :] > 0.5)[None, :]
+
+    dx = ci[:, 0][:, None] - cj[:, 0][None, :]
+    dy = ci[:, 1][:, None] - cj[:, 1][None, :]
+    R = jnp.sqrt(dx * dx + dy * dy + 1e-20)
+    zP = jnp.broadcast_to(ci[:, 2][:, None], R.shape)
+    zQ = jnp.broadcast_to(cj[:, 2][None, :], R.shape)
+
+    k = k_ref[0, 0]
+    if finite_depth:
+        k0 = k0_ref[0, 0]
+        A0 = A0_ref[0, 0]
+        lam = lam_ref[0, :]
+        a_fit = a_ref[0, :]
+
+        def fd_branch(_):
+            return _jb._wave_fd(k0, A0, lam, a_fit, depth, R, dx, dy,
+                                zP, zQ, area, diag_lid, tab)
+
+        def deep_branch(_):
+            return _jb._wave_deep(k, R, dx, dy, zP + zQ, area, diag_lid,
+                                  tab)
+
+        G, gx, gy, gz = lax.cond(act_ref[0, 0] > 0.5, fd_branch,
+                                 deep_branch, operand=None)
+    else:
+        G, gx, gy, gz = _jb._wave_deep(k, R, dx, dy, zP + zQ, area,
+                                       diag_lid, tab)
+
+    area_row = area[None, :]
+    sre_ref[...] = (Rp_ref[...] + G.re * area_row) * colm
+    sim_ref[...] = (G.im * area_row) * colm
+    proj_re = (gx.re * ni[:, 0][:, None] + gy.re * ni[:, 1][:, None]
+               + gz.re * ni[:, 2][:, None])
+    proj_im = (gx.im * ni[:, 0][:, None] + gy.im * ni[:, 1][:, None]
+               + gz.im * ni[:, 2][:, None])
+    dre_ref[...] = (Rdn_ref[...] + proj_re * area_row) * colm
+    dim_ref[...] = (proj_im * area_row) * colm
+
+
+def wave_assembly(R_pot, R_dn, c, nrm, area, panel_mask, lid_surface,
+                  tab, k, fd_scal, *, finite_depth: bool, depth: float,
+                  interpret: bool | None = None):
+    """Tiled wave part + combine for ONE frequency: returns the
+    assembled ``(S_re, S_im, Dn_re, Dn_im)`` (n, n) matrices.
+
+    ``k`` is the deep-water wavenumber scalar; ``fd_scal`` the
+    per-frequency finite-depth fit ``{"k0", "A0", "active", "lam", "a"}``
+    (ignored when ``finite_depth`` is False — zero placeholders are
+    staged so the operand list is route-static).  Safe under ``vmap``
+    over a frequency chunk: every per-frequency value is an operand.
+    """
+    n = R_pot.shape[0]
+    if not tile_ok(n):
+        raise ValueError(f"panel count {n} not a {TILE} multiple; "
+                         f"use the XLA assembly route")
+    dtype = R_pot.dtype
+    interpret = _interpret_default() if interpret is None else interpret
+    g = n // TILE
+    nlam = fd_scal["lam"].shape[-1] if finite_depth else 1
+
+    def s11(x):
+        return jnp.asarray(x, dtype).reshape(1, 1)
+
+    if finite_depth:
+        k0 = s11(fd_scal["k0"])
+        A0 = s11(fd_scal["A0"])
+        act = s11(fd_scal["active"])
+        lam = jnp.asarray(fd_scal["lam"], dtype).reshape(1, nlam)
+        a_f = jnp.asarray(fd_scal["a"], dtype).reshape(1, nlam)
+    else:
+        k0 = A0 = act = s11(0.0)
+        lam = a_f = jnp.zeros((1, nlam), dtype)
+
+    ids = jnp.arange(n, dtype=jnp.int32)[None, :]
+    row1 = lambda x: jnp.asarray(x, dtype).reshape(1, n)
+    full = lambda shape: pl.BlockSpec(shape, lambda i, j: (0,) * len(shape))
+    tile = pl.BlockSpec((TILE, TILE), lambda i, j: (i, j))
+    irow = pl.BlockSpec((1, TILE), lambda i, j: (0, i))
+    jrow = pl.BlockSpec((1, TILE), lambda i, j: (0, j))
+
+    kernel = functools.partial(_wave_kernel, finite_depth, float(depth))
+    outs = pl.pallas_call(
+        kernel,
+        grid=(g, g),
+        in_specs=[
+            tile, tile,                                        # R_pot, R_dn
+            pl.BlockSpec((TILE, 3), lambda i, j: (i, 0)),      # c_i
+            pl.BlockSpec((TILE, 3), lambda i, j: (i, 0)),      # nrm_i
+            pl.BlockSpec((TILE, 3), lambda i, j: (j, 0)),      # c_j
+            jrow, jrow, jrow,                  # area, mask, lid-surface
+            irow, jrow,                        # row ids, col ids
+            full(tab["I0"].shape), full(tab["I1"].shape),
+            full((1, 16)), full((1, 16)),      # near-quadrature GL nodes
+            full((1, 1)), full((1, 1)), full((1, 1)), full((1, 1)),
+            full((1, nlam)), full((1, nlam)),
+        ],
+        out_specs=(tile, tile, tile, tile),
+        out_shape=tuple(jax.ShapeDtypeStruct((n, n), dtype)
+                        for _ in range(4)),
+        interpret=interpret,
+    )(
+        R_pot, R_dn, c, nrm, c,
+        row1(area), row1(panel_mask), row1(jnp.where(lid_surface, 1.0, 0.0)),
+        ids, ids, tab["I0"], tab["I1"],
+        _gl_rows(dtype)[0], _gl_rows(dtype)[1],
+        s11(k), k0, A0, act, lam, a_f,
+    )
+    return outs

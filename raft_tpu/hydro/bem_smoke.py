@@ -22,6 +22,14 @@ Protocol (real process boundaries, the cache-/hetero-/serve-smoke rule):
 5. The parent solves both geometries through the native oracle
    (``cache=False``) and pins max scale-relative |jax - native| on A, B
    and F within :data:`raft_tpu.hydro.jax_bem.PARITY_RTOL`.
+6. CHILDREN 4-5 repeat the cold + novel legs with
+   ``RAFT_TPU_BEM_ASSEMBLY=pallas`` (the tiled assembly kernels of
+   :mod:`raft_tpu.core.pallas_bem`; interpreter mode off-TPU): cold
+   compiles under its own key-salted AOT key, the novel geometry is
+   again ZERO compiles, oracle parity holds, and the pallas A/B/F agree
+   with the XLA route within
+   :data:`raft_tpu.core.pallas_bem.INTERP_PARITY_RTOL` — still with
+   g++ poisoned.
 
 Prints exactly ONE JSON line; exits 0 iff every check passed.
 """
@@ -128,13 +136,14 @@ def main() -> int:
         env["RAFT_TPU_CACHE_DIR"] = root
         env.setdefault("JAX_PLATFORMS", "cpu")
 
-        def run_child(variant, tag):
+        def run_child(variant, tag, extra_env=None):
             out = os.path.join(ws, f"{tag}.npz")
             t0 = time.perf_counter()
             proc = subprocess.run(
                 [sys.executable, "-m", "raft_tpu.hydro.bem_smoke",
                  "--child", variant, out],
-                env=env, timeout=600, capture_output=True, text=True)
+                env=env if not extra_env else env | extra_env,
+                timeout=600, capture_output=True, text=True)
             if proc.returncode != 0:
                 raise RuntimeError(
                     f"child {tag} rc={proc.returncode}: "
@@ -146,6 +155,11 @@ def main() -> int:
         cold = run_child("a", "cold")
         warm = run_child("a", "warm")
         novel = run_child("b", "novel")
+        # the tiled-assembly leg: same protocol, pallas route pinned
+        # (interpreter mode off-TPU), its own key-salted executable
+        pal = {"RAFT_TPU_BEM_ASSEMBLY": "pallas"}
+        pallas_cold = run_child("a", "pallas_cold", pal)
+        pallas_novel = run_child("b", "pallas_novel", pal)
 
         def parity(got, variant):
             An, Bn, Fn = oracle[variant]
@@ -156,6 +170,15 @@ def main() -> int:
 
         par_a = parity(cold, "a")
         par_b = parity(novel, "b")
+        par_pa = parity(pallas_cold, "a")
+        par_pb = parity(pallas_novel, "b")
+        from raft_tpu.core.pallas_bem import INTERP_PARITY_RTOL
+
+        err = jax_bem.parity_err
+        cross = {"A": err(pallas_cold["A"], cold["A"]),
+                 "B": err(pallas_cold["B"], cold["B"]),
+                 "F": err(pallas_cold["F_re"] + 1j * pallas_cold["F_im"],
+                          cold["F_re"] + 1j * cold["F_im"])}
         tol = jax_bem.PARITY_RTOL
         checks = {
             "gxx_never_invoked": not os.path.exists(marker),
@@ -169,20 +192,39 @@ def main() -> int:
             "residual_small":
                 max(float(cold["max_residual"]),
                     float(novel["max_residual"])) < 1e-4,
+            # the pallas-interpret leg: own cold compile (route is
+            # key-salted), novel-geometry zero compiles, oracle parity,
+            # and cross-route agreement with the XLA leg
+            "pallas_cold_compiled": int(pallas_cold["compiles"]) >= 1,
+            "pallas_novel_zero_compiles":
+                int(pallas_novel["compiles"]) == 0,
+            "pallas_parity_a": all(v <= tol for v in par_pa.values()),
+            "pallas_parity_b": all(v <= tol for v in par_pb.values()),
+            "pallas_xla_agree": all(v <= INTERP_PARITY_RTOL
+                                    for v in cross.values()),
         }
         result = {
             "ok": all(checks.values()),
             "checks": checks,
-            "parity": {"a": par_a, "b": par_b, "rtol": tol},
+            "parity": {"a": par_a, "b": par_b, "rtol": tol,
+                       "pallas_a": par_pa, "pallas_b": par_pb,
+                       "cross_route": cross,
+                       "cross_rtol": INTERP_PARITY_RTOL},
             "cold_solve_s": float(cold["wall_s"]),
             "warm_solve_s": float(warm["wall_s"]),
             "novel_solve_s": float(novel["wall_s"]),
+            "pallas_cold_solve_s": float(pallas_cold["wall_s"]),
+            "pallas_novel_solve_s": float(pallas_novel["wall_s"]),
             "compiles": {"cold": int(cold["compiles"]),
                          "warm": int(warm["compiles"]),
-                         "novel": int(novel["compiles"])},
+                         "novel": int(novel["compiles"]),
+                         "pallas_cold": int(pallas_cold["compiles"]),
+                         "pallas_novel": int(pallas_novel["compiles"])},
             "padded_panels": int(cold["padded"]),
             "max_residual": float(max(cold["max_residual"],
-                                      novel["max_residual"])),
+                                      novel["max_residual"],
+                                      pallas_cold["max_residual"],
+                                      pallas_novel["max_residual"])),
             "wall_s": time.perf_counter() - t_start,
         }
     except Exception as e:                       # noqa: BLE001

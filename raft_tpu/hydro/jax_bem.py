@@ -71,6 +71,13 @@ from jax import lax
 
 from raft_tpu.core import bessel
 from raft_tpu.core.cplx import Cx
+from raft_tpu.core.linalg6 import (
+    LU_BLOCK,
+    lu_factor_blocked,
+    lu_factor_unblocked,
+    lu_solve_blocked,
+    lu_solve_unblocked,
+)
 from raft_tpu.hydro import wavetable
 
 log = logging.getLogger(__name__)
@@ -79,9 +86,18 @@ Array = jnp.ndarray
 
 ENV_VAR = "RAFT_TPU_BEM"
 
+#: assembly-route knob: ``xla`` | ``pallas`` | ``auto`` (pallas iff TPU)
+ASSEMBLY_ENV = "RAFT_TPU_BEM_ASSEMBLY"
+
+#: assembly-precision knob: ``f32`` (default) | ``bf16`` (bf16 assembly
+#: feeding the f32 factor+refine; the f64 host oracle is untouched)
+PRECISION_ENV = "RAFT_TPU_BEM_PRECISION"
+
 #: kernel version, folded into AOT keys and the result-cache key — bump on
 #: any numerical change so warm artifacts can never go stale silently
-KERNEL_VERSION = "jaxbem-v1"
+#: (v2: fused Rankine collapse, blocked panel LU, chunked frequency vmap —
+#: same math, different summation association, so results move at roundoff)
+KERNEL_VERSION = "jaxbem-v2"
 
 #: f32 LU refinement steps (the "f32 blocks with iterative refinement"
 #: contract); 2 steps bring the solve residual to f32 roundoff for the
@@ -178,6 +194,75 @@ def resolved_mode(mode: str | None = None) -> str:
     return "jax" if backend == "tpu" else "native"
 
 
+# ------------------------------------------- assembly route + precision
+
+_assembly_warned = False
+_precision_warned = False
+
+
+def assembly_mode(env: str | None = None) -> str:
+    """The ``RAFT_TPU_BEM_ASSEMBLY`` knob: ``xla`` | ``pallas`` |
+    ``auto`` (unset/empty; malformed degrades to auto with a one-time
+    warning — the ``RAFT_TPU_BEM`` empty-knob rule)."""
+    global _assembly_warned
+    raw = os.environ.get(ASSEMBLY_ENV, "") if env is None else env
+    val = raw.strip().lower()
+    if val in ("", "auto"):
+        return "auto"
+    if val in ("xla", "pallas"):
+        return val
+    with _mode_lock:
+        if not _assembly_warned:
+            _assembly_warned = True
+            log.warning("%s=%r is not one of xla|pallas|auto; using auto",
+                        ASSEMBLY_ENV, raw)
+    return "auto"
+
+
+def resolved_assembly(mode: str | None = None) -> str:
+    """``xla`` or ``pallas`` after resolving ``auto`` (pallas exactly
+    when the default backend is a TPU — on CPU the tiled kernels would
+    run in interpreter mode, slower than XLA; tests/smoke opt in
+    explicitly).  An explicit ``mode`` forces the route; an explicit
+    ``auto`` defers to the env knob first (the :func:`resolved_mode`
+    override contract)."""
+    m = assembly_mode() if mode is None else assembly_mode(env=mode)
+    if m == "auto" and mode is not None:
+        m = assembly_mode()
+    if m != "auto":
+        return m
+    try:
+        backend = jax.default_backend()
+    except Exception:
+        backend = "cpu"
+    return "pallas" if backend == "tpu" else "xla"
+
+
+def bem_precision(env: str | None = None) -> str:
+    """The ``RAFT_TPU_BEM_PRECISION`` knob: ``f32`` (default) | ``bf16``.
+
+    ``bf16`` runs the influence-matrix ASSEMBLY (Rankine quadrature +
+    wave part, either route) in bfloat16 while the 2n x 2n factor, the
+    refinement loop and the RHS stay f32 — the iterative-refinement
+    residual histogram (``bem.refine_resid``) is the live guardrail on
+    what the cheaper assembly costs.  The f64 host oracle never sees
+    this knob.  Malformed values degrade to ``f32`` with a one-time
+    warning."""
+    global _precision_warned
+    raw = os.environ.get(PRECISION_ENV, "") if env is None else env
+    val = raw.strip().lower()
+    if val in ("", "f32", "float32"):
+        return "f32"
+    if val in ("bf16", "bfloat16"):
+        return "bf16"
+    with _mode_lock:
+        if not _precision_warned:
+            _precision_warned = True
+            log.warning("%s=%r is not one of f32|bf16; using f32",
+                        PRECISION_ENV, raw)
+    return "f32"
+
+
 # -------------------------------------------------------- panel bucketing
 
 def pad_panel_count(n_total: int) -> int:
@@ -235,9 +320,13 @@ def _phi_near(zr, zi):
     return phr, phi, dphr, dphi
 
 
-def _near_integrals(X, Y):
+def _near_integrals(X, Y, nodes=None):
     """(I0, I1) by direct theta quadrature — valid (and f32-safe) for
-    rho = |(X, Y)| <= R_NEAR; callers select with the near mask."""
+    rho = |(X, Y)| <= R_NEAR; callers select with the near mask.
+
+    ``nodes``: optional (x, w) Gauss-Legendre node arrays — the Pallas
+    kernels thread them through as operands (a kernel may not capture
+    constant arrays); default is the module-level 16-point rule."""
     def body(carry, node):
         acc0, accX = carry
         x, wgt = node
@@ -250,7 +339,8 @@ def _near_integrals(X, Y):
         accX = accX - w * s * dphi
         return (acc0, accX), None
 
-    nodes = (jnp.asarray(_GL16_X, X.dtype), jnp.asarray(_GL16_W, X.dtype))
+    if nodes is None:
+        nodes = (jnp.asarray(_GL16_X, X.dtype), jnp.asarray(_GL16_W, X.dtype))
     (acc0, accX), _ = lax.scan(body, (jnp.zeros_like(X), jnp.zeros_like(X)),
                                nodes)
     i0 = acc0 / _PI
@@ -285,7 +375,7 @@ def eval_wave_integrals(X, Y, tab):
     # the region so the series/log stay finite — double-where)
     Xn = jnp.where(near, X, 0.1)
     Yn = jnp.where(near, Y, -0.1)
-    i0_near, i1_near = _near_integrals(Xn, Yn)
+    i0_near, i1_near = _near_integrals(Xn, Yn, nodes=tab.get("nodes"))
     # table branch
     s = jnp.log1p(-Y)
     fx = jnp.clip(X, 0.0, wavetable.XMAX) / (wavetable.XMAX / NXm1)
@@ -532,8 +622,12 @@ def _wave_fd(k0, A0, lam, a_fit, h, R, dx, dy, zP, zQ, area_j, diag_lid,
         -(zP + zQ), 2.0 * h - (zP - zQ), 2.0 * h + (zP - zQ),
         4.0 * h + (zP + zQ),
     ])                                                     # (4, n, n)
-    sgn = jnp.asarray([-1.0, -1.0, 1.0, 1.0], dtype)[:, None, None]
-    img1 = jnp.asarray([0.0, 1.0, 1.0, 1.0], dtype)[:, None, None]
+    # image sign/gating vectors built from iota, not literal arrays —
+    # this function also runs inside the Pallas wave kernel, and a
+    # kernel may not capture constant arrays
+    i4 = lax.broadcasted_iota(dtype, (4, 1, 1), 0)
+    sgn = jnp.where(i4 < 2.0, -1.0, 1.0)
+    img1 = jnp.where(i4 < 1.0, 0.0, 1.0)
     X = k0 * R_use
     J0 = bessel.j0(X)
     J1 = bessel.j1(X)
@@ -599,58 +693,17 @@ def _wave_fd(k0, A0, lam, a_fit, h, R, dx, dy, zP, zQ, area_j, diag_lid,
 # embeds a process-local function pointer — a warm process deserializing
 # it from the AOT registry segfaults on first execution (measured on
 # jaxlib 0.4.37; the same reason linalg6/eigen hand-roll their solves).
-# Pure HLO serializes and round-trips on every backend, and the solve is
-# O(n^3) either way while the O(n^2 * quad) assembly dominates the
-# kernel.
+# Pure HLO serializes and round-trips on every backend.  The hot path is
+# the BLOCKED right-looking factorization (raft_tpu.core.linalg6): the
+# 2n-step rank-1 chain of the v1 row-by-row scan collapses to 2n / b
+# panel+GEMM steps, which is what lets the 2n x 2n solve keep up with
+# the tiled assembly instead of becoming the new serial bottleneck.  The
+# row-by-row variant stays importable as the bit-level reference
+# (tests/test_bem_tiles.py pins blocked == unblocked through pivoting).
 
-
-def _lu_factor_jnp(A):
-    """In-place LU with partial pivoting: returns (LU, perm) with unit-
-    lower L below the diagonal and U on/above it (the LAPACK layout)."""
-    m = A.shape[0]
-    idx = jnp.arange(m)
-
-    def step(carry, k):
-        A, perm = carry
-        col = A[:, k]
-        mag = jnp.where(idx >= k, jnp.abs(col), -1.0)
-        p = jnp.argmax(mag)
-        rowk, rowp = A[k], A[p]
-        A = A.at[k].set(rowp).at[p].set(rowk)
-        pk, pp = perm[k], perm[p]
-        perm = perm.at[k].set(pp).at[p].set(pk)
-        piv = A[k, k]
-        piv = jnp.where(jnp.abs(piv) > 1e-30, piv, 1e-30)
-        f = jnp.where(idx > k, A[:, k] / piv, 0.0)
-        rowk_u = jnp.where(idx >= k, A[k], 0.0)     # U part of the pivot row
-        A = A - jnp.outer(f, rowk_u)
-        A = A.at[:, k].set(jnp.where(idx > k, f, A[:, k]))
-        return (A, perm), None
-
-    (LU, perm), _ = lax.scan(step, (A, idx), jnp.arange(m))
-    return LU, perm
-
-
-def _lu_solve_jnp(LU, perm, B):
-    """Forward/back substitution for all RHS columns at once."""
-    m = LU.shape[0]
-    idx = jnp.arange(m)
-    X = B[perm]
-
-    def fwd(k, X):
-        lk = jnp.where(idx < k, LU[k], 0.0)
-        return X.at[k].add(-(lk @ X))
-
-    X = lax.fori_loop(0, m, fwd, X)
-
-    def bwd(i, X):
-        k = m - 1 - i
-        uk = jnp.where(idx > k, LU[k], 0.0)
-        dk = LU[k, k]
-        dk = jnp.where(jnp.abs(dk) > 1e-30, dk, 1e-30)
-        return X.at[k].set((X[k] - uk @ X) / dk)
-
-    return lax.fori_loop(0, m, bwd, X)
+# legacy aliases (v1 names, kept for external callers/tests)
+_lu_factor_jnp = lu_factor_unblocked
+_lu_solve_jnp = lu_solve_unblocked
 
 
 @jax.custom_vjp
@@ -661,11 +714,11 @@ def _solve_refined(M2, B2):
 
 
 def _solve_refined_impl(M2, B2):
-    LU, perm = _lu_factor_jnp(M2)
-    x = _lu_solve_jnp(LU, perm, B2)
+    LU, perm = lu_factor_blocked(M2, block=LU_BLOCK)
+    x = lu_solve_blocked(LU, perm, B2, block=LU_BLOCK)
     for _ in range(N_REFINE):
         r = B2 - M2 @ x
-        x = x + _lu_solve_jnp(LU, perm, r)
+        x = x + lu_solve_blocked(LU, perm, r, block=LU_BLOCK)
     return x
 
 
@@ -688,9 +741,35 @@ _solve_refined.defvjp(_solve_refined_fwd, _solve_refined_bwd)
 
 # --------------------------------------------------------- the panel solve
 
+def _freq_chunk(n: int, nw: int) -> int:
+    """Static frequency-batch width of the chunked ``vmap``: how many
+    2n x 2n systems (plus their assembly intermediates) ride one device
+    dispatch.  Shrinks with the padded panel class so the per-chunk
+    working set stays roughly constant (~a few hundred MB at f32); a
+    deterministic function of static shapes, so it can never retrace a
+    warm executable."""
+    return max(1, min(nw, 8, 2048 // max(n, 1)))
+
+
+def _rankine_fused(pans, c, nrm, area, diag, panel_mask, lid_surface):
+    """XLA-route Rankine collapse: the eight pot/grad outputs of
+    :func:`rankine_parts` reduced to the two matrices the combine
+    consumes — ``R_pot = pot_d + pot_i`` and
+    ``R_dn = (grad_d + grad_i) . n_i`` (the Pallas kernel emits the
+    same pair straight from VMEM)."""
+    pot_d, grad_d, pot_i, grad_i = rankine_parts(
+        pans, c, nrm, area, diag, panel_mask, lid_surface)
+    R_pot = pot_d + pot_i
+    R_dn = ((grad_d[..., 0] + grad_i[..., 0]) * nrm[:, 0][:, None]
+            + (grad_d[..., 1] + grad_i[..., 1]) * nrm[:, 1][:, None]
+            + (grad_d[..., 2] + grad_i[..., 2]) * nrm[:, 2][:, None])
+    return R_pot, R_dn
+
+
 def solve_panels(pans, panel_mask, lid_mask, w, betas, fd, tab, *,
                  rho: float = 1025.0, g: float = 9.81, depth: float = 0.0,
-                 finite_depth: bool = False, dtype=jnp.float32):
+                 finite_depth: bool = False, dtype=jnp.float32,
+                 assembly: str | None = None, precision: str | None = None):
     """The traced core: padded panels -> (A, B, F, residual).
 
     Args (arrays; everything is cast to ``dtype``):
@@ -706,12 +785,23 @@ def solve_panels(pans, panel_mask, lid_mask, w, betas, fd, tab, *,
 
     Static: ``rho``/``g``/``depth`` (baked scalars), ``finite_depth``
     (routes the per-frequency ``lax.cond`` between the deep and 4-image
-    kernels), ``dtype``.
+    kernels), ``dtype``, plus the two route knobs — ``assembly``
+    (``xla`` | ``pallas`` | ``auto``/None, resolved via the key-salted
+    ``RAFT_TPU_BEM_ASSEMBLY``; non-tile-aligned panel counts always take
+    the XLA route) and ``precision`` (``f32`` | ``bf16`` | None =
+    ``RAFT_TPU_BEM_PRECISION``; bf16 runs the assembly stage only — the
+    factor, refinement and RHS stay at ``dtype``).
+
+    Frequencies are batched: ``one_freq`` is ``vmap``-ed over chunks of
+    :func:`_freq_chunk` frequencies and ``lax.map``-ed over chunks (the
+    v1 code mapped frequencies one at a time, leaving the device under-
+    occupied at small panel counts).
 
     Returns ``(A, B, F, resid)``: A/B (nw, 6, 6) with [j, k] = force j
     per unit mode-k motion, F a :class:`Cx` (nw, nb, 6), and resid (nw,)
     the max relative linear-system residual after refinement (the
-    measured f32-vs-oracle quality signal).
+    measured f32-vs-oracle quality signal — and the live bf16 guardrail,
+    exported as the ``bem.refine_resid`` histogram).
     """
     pans = jnp.asarray(pans, dtype)
     panel_mask = jnp.asarray(panel_mask, dtype)
@@ -723,22 +813,53 @@ def solve_panels(pans, panel_mask, lid_mask, w, betas, fd, tab, *,
     n = pans.shape[0]
     nb = betas.shape[0]
 
+    from raft_tpu.core import pallas_bem
+
+    route = resolved_assembly(assembly)
+    if route == "pallas" and not pallas_bem.tile_ok(n):
+        route = "xla"           # custom non-tile-aligned ladder class
+    prec = bem_precision() if precision is None else bem_precision(
+        env=precision)
+    # assembly-stage dtype: bf16 applies only to the f32 device solve
+    # (the f64 oracle path ignores the knob by construction).  Dict
+    # lookup, not a ternary: `dtype` is a static Python dtype here, but
+    # it is also a parameter of this jit-reachable function, and the
+    # GL103 branch rule cannot tell those apart
+    a_dtype = {True: jnp.bfloat16, False: dtype}[
+        prec == "bf16" and dtype == jnp.float32]
+
     c, nrm, area, diag = panel_geometry(pans)
     hull_mask = panel_mask * (1.0 - lid_mask)
     # lid panels sitting AT z = 0 (their free-surface image is themselves)
     lid_surface = (lid_mask > 0.5) & (jnp.abs(c[:, 2]) < 1e-6
                                       * jnp.maximum(diag, 1e-9))
-    pot_d, grad_d, pot_i, grad_i = rankine_parts(
-        pans, c, nrm, area, diag, panel_mask, lid_surface)
 
-    dx = c[:, None, 0] - c[None, :, 0]
-    dy = c[:, None, 1] - c[None, :, 1]
-    R = jnp.sqrt(dx * dx + dy * dy + 1e-20)
-    zP = jnp.broadcast_to(c[:, 2][:, None], (n, n))
-    zQ = jnp.broadcast_to(c[:, 2][None, :], (n, n))
-    v = zP + zQ
-    eye = jnp.eye(n, dtype=bool)
-    diag_lid = eye & lid_surface[None, :]
+    c_a = c.astype(a_dtype)
+    nrm_a = nrm.astype(a_dtype)
+    area_a = area.astype(a_dtype)
+    mask_a = panel_mask.astype(a_dtype)
+    tab_a = {kk: v_.astype(a_dtype) for kk, v_ in tab.items()}
+
+    if route == "pallas":
+        self_pot = self_potential(pans, c, nrm)       # O(n), stays XLA
+        R_pot, R_dn = pallas_bem.rankine_assembly(
+            pans.astype(a_dtype), c_a, nrm_a, area_a,
+            diag.astype(a_dtype), mask_a, lid_surface,
+            self_pot.astype(a_dtype))
+    else:
+        R_pot, R_dn = _rankine_fused(
+            pans.astype(a_dtype), c_a, nrm_a, area_a,
+            diag.astype(a_dtype), mask_a, lid_surface)
+        # pairwise wave-part geometry (the Pallas kernel derives these
+        # per tile in VMEM; the XLA route materializes them once)
+        dx = c_a[:, None, 0] - c_a[None, :, 0]
+        dy = c_a[:, None, 1] - c_a[None, :, 1]
+        R = jnp.sqrt(dx * dx + dy * dy + 1e-20)
+        zP = jnp.broadcast_to(c_a[:, 2][:, None], (n, n))
+        zQ = jnp.broadcast_to(c_a[:, 2][None, :], (n, n))
+        v = zP + zQ
+        eye = jnp.eye(n, dtype=bool)
+        diag_lid = eye & lid_surface[None, :]
 
     nvec6 = jnp.concatenate([nrm, jnp.cross(c, nrm)], axis=1)   # (n, 6)
     dtyp = pans.dtype
@@ -746,32 +867,48 @@ def solve_panels(pans, panel_mask, lid_mask, w, betas, fd, tab, *,
     def one_freq(xs):
         om = xs["w"]
         k = om * om / g
-        if finite_depth:
-            def fd_branch(_):
-                return _wave_fd(xs["k0"], xs["A0"], xs["lam"], xs["a"],
-                                depth, R, dx, dy, zP, zQ, area, diag_lid,
-                                tab)
-
-            def deep_branch(_):
-                return _wave_deep(k, R, dx, dy, v, area, diag_lid, tab)
-
-            G, gx, gy, gz = lax.cond(xs["active"] > 0.5, fd_branch,
-                                     deep_branch, operand=None)
+        if route == "pallas":
+            fd_scal = ({"k0": xs["k0"], "A0": xs["A0"],
+                        "active": xs["active"], "lam": xs["lam"],
+                        "a": xs["a"]} if finite_depth else None)
+            S_re_a, S_im_a, Dn_re_a, Dn_im_a = pallas_bem.wave_assembly(
+                R_pot, R_dn, c_a, nrm_a, area_a, mask_a, lid_surface,
+                tab_a, k, fd_scal, finite_depth=finite_depth, depth=depth)
         else:
-            G, gx, gy, gz = _wave_deep(k, R, dx, dy, v, area, diag_lid,
-                                       tab)
-        area_row = area[None, :]
-        colm = panel_mask[None, :]
-        S = Cx((pot_d + pot_i + G.re * area_row) * colm,
-               (G.im * area_row) * colm)
-        Dn_re = ((grad_d[..., 0] + grad_i[..., 0] + gx.re * area_row)
-                 * nrm[:, 0][:, None]
-                 + (grad_d[..., 1] + grad_i[..., 1] + gy.re * area_row)
-                 * nrm[:, 1][:, None]
-                 + (grad_d[..., 2] + grad_i[..., 2] + gz.re * area_row)
-                 * nrm[:, 2][:, None]) * colm
-        Dn_im = ((gx.im * nrm[:, 0][:, None] + gy.im * nrm[:, 1][:, None]
-                  + gz.im * nrm[:, 2][:, None]) * area_row) * colm
+            k_a = k.astype(a_dtype)
+            if finite_depth:
+                def fd_branch(_):
+                    return _wave_fd(
+                        xs["k0"].astype(a_dtype), xs["A0"].astype(a_dtype),
+                        xs["lam"].astype(a_dtype), xs["a"].astype(a_dtype),
+                        depth, R, dx, dy, zP, zQ, area_a, diag_lid, tab_a)
+
+                def deep_branch(_):
+                    return _wave_deep(k_a, R, dx, dy, v, area_a, diag_lid,
+                                      tab_a)
+
+                G, gx, gy, gz = lax.cond(xs["active"] > 0.5, fd_branch,
+                                         deep_branch, operand=None)
+            else:
+                G, gx, gy, gz = _wave_deep(k_a, R, dx, dy, v, area_a,
+                                           diag_lid, tab_a)
+            area_row = area_a[None, :]
+            colm = mask_a[None, :]
+            S_re_a = (R_pot + G.re * area_row) * colm
+            S_im_a = (G.im * area_row) * colm
+            proj_re = (gx.re * nrm_a[:, 0][:, None]
+                       + gy.re * nrm_a[:, 1][:, None]
+                       + gz.re * nrm_a[:, 2][:, None])
+            proj_im = (gx.im * nrm_a[:, 0][:, None]
+                       + gy.im * nrm_a[:, 1][:, None]
+                       + gz.im * nrm_a[:, 2][:, None])
+            Dn_re_a = (R_dn + proj_re * area_row) * colm
+            Dn_im_a = (proj_im * area_row) * colm
+        # assembly -> solve dtype boundary (bf16 mode upcasts HERE: the
+        # factor + refinement always run at the solve dtype)
+        S = Cx(S_re_a.astype(dtyp), S_im_a.astype(dtyp))
+        Dn_re = Dn_re_a.astype(dtyp)
+        Dn_im = Dn_im_a.astype(dtyp)
         eyef = jnp.eye(n, dtype=dtyp)
         M_re = Dn_re - _TWO_PI * eyef
         M_im = Dn_im
@@ -840,7 +977,21 @@ def solve_panels(pans, panel_mask, lid_mask, w, betas, fd, tab, *,
 
     xs = {"w": w, "active": fd["active"], "k0": fd["k0"], "A0": fd["A0"],
           "lam": fd["lam"], "a": fd["a"], "kw": fd["kw"]}
-    A6, B6, F_re, F_im, resid = lax.map(jax.checkpoint(one_freq), xs)
+    # chunked frequency batching: vmap one_freq over a VMEM-sized chunk,
+    # lax.map over chunks (padded by repeating the last frequency — the
+    # padded lanes are sliced off below, they just keep chunks uniform)
+    nw = w.shape[0]
+    chunk = _freq_chunk(n, nw)
+    nck = -(-nw // chunk)
+    pad = nck * chunk - nw
+    if pad:
+        xs = {kk: jnp.concatenate([v_, jnp.repeat(v_[-1:], pad, axis=0)])
+              for kk, v_ in xs.items()}
+    xs = {kk: v_.reshape((nck, chunk) + v_.shape[1:])
+          for kk, v_ in xs.items()}
+    outs = lax.map(jax.checkpoint(jax.vmap(one_freq)), xs)
+    A6, B6, F_re, F_im, resid = (
+        o.reshape((nck * chunk,) + o.shape[2:])[:nw] for o in outs)
     return A6, B6, Cx(F_re, F_im), resid
 
 
@@ -881,6 +1032,8 @@ def solve_bem_jax(
     lid: np.ndarray | None = None,
     dtype=None,
     return_diagnostics: bool = False,
+    assembly: str | None = None,
+    precision: str | None = None,
 ):
     """On-device panel solve with the native ``solve_bem`` contract:
     returns (A[6, 6, nw], B[6, 6, nw], F) with F[6, nw] complex for a
@@ -904,6 +1057,11 @@ def solve_bem_jax(
     betas = np.ascontiguousarray(np.atleast_1d(beta), dtype=np.float64)  # graftlint: disable=GL105 — host staging
     depth_f = float(depth) if depth and depth > 0 else -1.0
     dtype = jnp.float32 if dtype is None else dtype
+    # resolve the route knobs ONCE here so the result-cache key, the AOT
+    # statics and the traced program all see the same values
+    route = resolved_assembly(assembly)
+    prec = bem_precision() if precision is None else bem_precision(
+        env=precision)
 
     key = None
     if cache:
@@ -911,7 +1069,7 @@ def solve_bem_jax(
             "bem-jax", panels, w_np, betas,
             (rho, g, depth_f, 0.0, float(0 if lid is None else len(lid))),
             salt=(KERNEL_VERSION, wavetable.TABLE_VERSION, N_REFINE,
-                  str(jnp.dtype(dtype))),
+                  str(jnp.dtype(dtype)), route, prec),
             extra_bytes=(np.asarray(lid, dtype=np.float64).tobytes()  # graftlint: disable=GL105 — content hashing
                          if lid is not None and len(lid) else b""),
         )
@@ -938,7 +1096,8 @@ def solve_bem_jax(
     fn = functools.partial(
         solve_panels, rho=float(rho), g=float(g),
         depth=float(depth_f if finite_depth else 0.0),
-        finite_depth=finite_depth, dtype=dtype)
+        finite_depth=finite_depth, dtype=dtype,
+        assembly=route, precision=prec)
     args = (
         jnp.asarray(padded, dtype), jnp.asarray(panel_mask, dtype),
         jnp.asarray(lid_mask, dtype), jnp.asarray(w_np, dtype),
@@ -953,7 +1112,8 @@ def solve_bem_jax(
                ("table", wavetable.TABLE_VERSION),
                ("refine", N_REFINE), ("rho", float(rho)), ("g", float(g)),
                ("depth", float(depth_f)), ("fd", bool(finite_depth)),
-               ("dtype", str(jnp.dtype(dtype))))
+               ("dtype", str(jnp.dtype(dtype))),
+               ("assembly", route), ("precision", prec))
     if _cfg.is_enabled():
         exe = cached_callable("jax_bem", fn, args, extra=statics)
     else:
@@ -970,9 +1130,18 @@ def solve_bem_jax(
         A6, B6 = np.asarray(A6, float), np.asarray(B6, float)
         F = np.asarray(F_cx.re, float) + 1j * np.asarray(F_cx.im, float)
         resid = np.asarray(resid, float)
-    _obs.metrics.histogram("bem.jax_solve_s").observe(
-        _time.perf_counter() - t0)
+    dt = _time.perf_counter() - t0
+    _obs.metrics.histogram("bem.jax_solve_s").observe(dt)
+    # per-panel-bucket latency: one histogram per padded class, so the
+    # ledger's per-(entry, bucket) rooflines have a live counterpart
+    _obs.metrics.histogram(f"bem.solve_s[{len(padded)}]").observe(dt)
     _obs.metrics.histogram("bem.jax_residual").observe(float(resid.max()))
+    # the refinement residual per frequency — the mixed-precision
+    # (RAFT_TPU_BEM_PRECISION) guardrail as a live metric, not just a
+    # bench scalar
+    refine_h = _obs.metrics.histogram("bem.refine_resid")
+    for r_ in resid:
+        refine_h.observe(float(r_))
 
     A = A6.transpose(1, 2, 0)                       # (6, 6, nw)
     B = B6.transpose(1, 2, 0)
@@ -1062,11 +1231,14 @@ def make_bem_fn(panels, w, *, rho=1025.0, g=9.81, depth=0.0, beta=0.0,
 
     def bem_fn(theta):
         p = warp_fn(pans0, theta)
+        # assembly pinned to the XLA route: the Pallas tiles carry no AD
+        # rules, and this hook exists to be differentiated — the solve
+        # adjoint (custom_vjp) is route-independent either way
         A6, B6, F_cx, _resid = solve_panels(
             p, masks[0], masks[1], w_dev, betas, fd_dev, tab,
             rho=float(rho), g=float(g),
             depth=float(depth_f if finite_depth else 0.0),
-            finite_depth=finite_depth, dtype=dtype)
+            finite_depth=finite_depth, dtype=dtype, assembly="xla")
         return A6, B6, F_cx[:, 0, :]
 
     return bem_fn

@@ -78,6 +78,17 @@ KNOBS: tuple[Knob, ...] = (
          "Panel-solver routing: native host C++, on-device JAX, or auto",
          salted_via="raft_tpu.cache.aot._solver_salts",
          salt_token="bem_mode"),
+    Knob("RAFT_TPU_BEM_ASSEMBLY", "auto (pallas iff TPU)", "hydro.jax_bem",
+         AOT_KEY,
+         "BEM influence-matrix assembly route: tiled Pallas kernels or the "
+         "bit-comparable XLA fallback",
+         salted_via="raft_tpu.cache.aot._solver_salts",
+         salt_token="resolved_assembly()"),
+    Knob("RAFT_TPU_BEM_PRECISION", "f32", "hydro.jax_bem", AOT_KEY,
+         "BEM assembly precision (f32, or bf16 assembly with f32 factor + "
+         "refinement; the f64 host oracle is untouched)",
+         salted_via="raft_tpu.cache.aot._solver_salts",
+         salt_token="bem_precision()"),
     Knob("XLA_FLAGS", "unset", "cache.aot", AOT_KEY,
          "Raw XLA compiler flags (device counts, HLO dumps, ...)",
          salted_via="raft_tpu.cache.aot._solver_salts",

@@ -310,14 +310,14 @@ def _entry_serve_solve():
     return fn, args, args2
 
 
-def _entry_jax_bem():
-    """Traced core of :func:`raft_tpu.hydro.jax_bem.solve_panels` — the
-    on-device panel solve (influence assembly + factor-once refined
-    solve) on a tiny padded deep-water mesh.  The two argument pytrees
-    are two DIFFERENT geometries (radial scales) padded to one ``panels``
-    ladder class — the zero-retrace budget is exactly the "a novel
-    geometry on a warm executable pays only the device solve" claim, and
-    the zero-f64 budget pins the f32-blocks-with-refinement contract."""
+def _bem_entry(assembly: str, nw: int = 2):
+    """Shared fixture of the two ``jax_bem`` audit entries: the traced
+    core of :func:`raft_tpu.hydro.jax_bem.solve_panels` (influence
+    assembly + factor-once refined solve) on a tiny padded deep-water
+    mesh, with the assembly route pinned explicitly so each route gets
+    its own zero-retrace / zero-f64 / budget gate.  The two argument
+    pytrees are two DIFFERENT geometries (radial scales) padded to one
+    ``panels`` ladder class."""
     import functools
 
     import numpy as np
@@ -339,7 +339,7 @@ def _entry_jax_bem():
                              pt(p0, a1)])
         return np.asarray(pans)
 
-    w = np.array([0.9, 1.4])
+    w = np.array([0.9, 1.4])[:nw]
     fd = wavetable.fd_fit_grid(w, -1.0, 9.81)
     tab = jax_bem._stage_table(jnp.float32)
 
@@ -354,13 +354,34 @@ def _entry_jax_bem():
 
     fn = functools.partial(jax_bem.solve_panels, rho=1025.0, g=9.81,
                            depth=0.0, finite_depth=False,
-                           dtype=jnp.float32)
+                           dtype=jnp.float32, assembly=assembly)
 
     def wrapped(*a):
         A, B, F, resid = fn(*a)
         return A, B, F.re, F.im, resid
 
     return wrapped, args_for(1.0), args_for(1.07)
+
+
+def _entry_jax_bem():
+    """Traced core of :func:`raft_tpu.hydro.jax_bem.solve_panels` on the
+    XLA assembly route — the zero-retrace budget is exactly the "a novel
+    geometry on a warm executable pays only the device solve" claim, and
+    the zero-f64 budget pins the f32-blocks-with-refinement contract."""
+    return _bem_entry("xla")
+
+
+def _entry_jax_bem_pallas():
+    """The SAME panel solve through the tiled Pallas assembly route
+    (:mod:`raft_tpu.core.pallas_bem`; interpreter mode off-TPU — the
+    exact kernels the TPU runs compiled), so the zero-retrace /
+    zero-f64 / zero-host-callback budgets cover the kernel path end to
+    end: a ``pallas_call`` is a device op, not a host callback, and the
+    blocked LU downstream of it is shared with the XLA entry.  One
+    frequency keeps the interpreter-mode audit cheap — the route is
+    frequency-batched by the same ``lax.map(checkpoint(vmap))`` wrapper
+    either way, so nw=1 loses no structure."""
+    return _bem_entry("pallas", nw=1)
 
 
 def _entry_eigen():
@@ -405,6 +426,8 @@ ENTRY_POINTS: tuple[EntryPoint, ...] = (
                _entry_serve_solve, concurrent=True),
     EntryPoint("jax_bem", "raft_tpu.hydro.jax_bem.solve_panels",
                _entry_jax_bem),
+    EntryPoint("jax_bem_pallas", "raft_tpu.hydro.jax_bem.solve_panels",
+               _entry_jax_bem_pallas),
 )
 
 #: the daemon-facing host functions whose whole call path falls under the

@@ -1,0 +1,306 @@
+"""Tiled BEM assembly + blocked panel LU tests.
+
+Two contracts from the perf tentpole:
+
+* **Blocked LU** (:mod:`raft_tpu.core.linalg6`): the blocked
+  right-looking factorization is pinned against its row-by-row reference
+  twin — same pivot sequence, same LAPACK layout — on random,
+  pivot-stressed (tiny leading diagonals) and near-singular
+  (irregular-frequency lid-mesh conditioning) systems, at sizes that do
+  and do not divide the block, plus under ``vmap``.  The ``custom_vjp``
+  adjoint of the refined solve is re-pinned against finite differences
+  THROUGH the new factorization.
+* **Cross-route assembly parity**: the Pallas tiled kernels
+  (:mod:`raft_tpu.core.pallas_bem`, interpreter mode on CPU) agree with
+  the XLA assembly route within the documented
+  :data:`~raft_tpu.core.pallas_bem.INTERP_PARITY_RTOL` (the PR 3
+  dual-route precedent), deep and finite-depth, and the bf16 assembly
+  mode stays finite with its refinement-residual guardrail intact.
+
+The native-oracle parity pins (3e-5..9e-5 scale-relative) live in
+``tests/test_jax_bem.py`` and are untouched by the route split — both
+assembly routes feed the same factor/solve/combine tail.
+"""
+import numpy as np
+import pytest
+
+from raft_tpu.core.linalg6 import (
+    LU_BLOCK,
+    lu_factor_blocked,
+    lu_factor_unblocked,
+    lu_solve_blocked,
+    lu_solve_unblocked,
+)
+
+W2 = np.array([0.7, 1.3])
+
+
+def _mats(kind: str, m: int, seed: int = 0) -> np.ndarray:
+    rng = np.random.default_rng(seed)
+    A = rng.normal(size=(m, m))
+    if kind == "random":
+        return A + 2.0 * np.eye(m)
+    if kind == "pivot":
+        # tiny leading diagonals: row-by-row elimination without pivoting
+        # would divide by ~1e-12 immediately — every panel must pivot
+        A = A + 2.0 * np.eye(m)
+        A[np.diag_indices(m)] = 1e-12 * np.arange(1, m + 1)
+        return A
+    if kind == "near_singular":
+        # two nearly dependent rows (the lid-mesh irregular-frequency
+        # conditioning shape): cond ~ 1/eps_row, still factorable
+        A = A + 2.0 * np.eye(m)
+        A[m // 2] = A[m // 3] + 1e-9 * rng.normal(size=m)
+        return A
+    raise ValueError(kind)
+
+
+@pytest.mark.parametrize("kind", ["pivot", "near_singular"])
+@pytest.mark.parametrize("m", [37])
+def test_blocked_lu_matches_unblocked(kind, m):
+    """Same pivot sequence and factors as the row-by-row reference on a
+    ragged size (identity padding must never let a padded row win a
+    pivot search).  Fast tier keeps the two adversarial kinds at the
+    ragged m=37; the full kind x {24, 37, 64, 96} ladder rides in the
+    slow tier below (single-core tier-1 is budgeted)."""
+    import jax.numpy as jnp
+
+    A = jnp.asarray(_mats(kind, m), jnp.float64)
+    LUb, pb = lu_factor_blocked(A, block=16)
+    LUu, pu = lu_factor_unblocked(A)
+    np.testing.assert_array_equal(np.asarray(pb), np.asarray(pu))
+    scale = float(jnp.max(jnp.abs(LUu)))
+    assert float(jnp.max(jnp.abs(LUb - LUu))) <= 1e-10 * scale
+
+
+@pytest.mark.parametrize("m", [37])
+def test_blocked_solve_residual(m):
+    """factor+solve residual at dtype roundoff for a multi-RHS system,
+    blocked and reference paths agreeing on the solution."""
+    import jax.numpy as jnp
+
+    A = jnp.asarray(_mats("random", m, seed=3), jnp.float64)
+    B = jnp.asarray(np.random.default_rng(4).normal(size=(m, 5)))
+    LUb, pb = lu_factor_blocked(A, block=16)
+    Xb = lu_solve_blocked(LUb, pb, B, block=16)
+    LUu, pu = lu_factor_unblocked(A)
+    Xu = lu_solve_unblocked(LUu, pu, B)
+    assert float(jnp.max(jnp.abs(A @ Xb - B))) < 1e-10
+    assert float(jnp.max(jnp.abs(Xb - Xu))) < 1e-9
+    # vector RHS path
+    xv = lu_solve_blocked(LUb, pb, B[:, 0], block=16)
+    np.testing.assert_allclose(np.asarray(xv), np.asarray(Xb[:, 0]),
+                               rtol=1e-12, atol=1e-12)
+
+
+@pytest.mark.slow
+def test_blocked_lu_vmaps():
+    """The frequency-batched use: one vmapped factor+solve over a stack
+    of systems (the ``lax.map(checkpoint(vmap))`` wrapper relies on
+    this).  Slow tier — tracing dominates, and the fast tier already
+    drives this path through every ``jax_bem`` solve."""
+    import jax
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(7)
+    A = jnp.asarray(rng.normal(size=(2, 32, 32)) + 2 * np.eye(32))
+    B = jnp.asarray(rng.normal(size=(2, 32, 3)))
+
+    def solve(a, b):
+        lu, p = lu_factor_blocked(a, block=16)
+        return lu_solve_blocked(lu, p, b, block=16)
+
+    X = jax.vmap(solve)(A, B)
+    resid = jnp.max(jnp.abs(jnp.einsum("bij,bjk->bik", A, X) - B))
+    assert float(resid) < 1e-8
+
+
+def test_default_block_size_used_by_solver():
+    """The refined solve really runs the blocked path at LU_BLOCK (a
+    source pin: the hot path must not silently fall back to the
+    reference)."""
+    import inspect
+
+    from raft_tpu.hydro import jax_bem
+
+    src = inspect.getsource(jax_bem._solve_refined_impl)
+    assert "lu_factor_blocked" in src and "lu_solve_blocked" in src
+    assert LU_BLOCK >= 8
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("kind", ["random", "pivot", "near_singular"])
+@pytest.mark.parametrize("m", [24, 64, 96])
+def test_blocked_lu_matches_unblocked_wide(kind, m):
+    """The full size ladder for the pivot-sequence pin (single-panel
+    ragged 24, aligned 64, triple-panel 96, every kind) — slow tier;
+    the adversarial kinds at ragged 37 stay fast."""
+    import jax.numpy as jnp
+
+    A = jnp.asarray(_mats(kind, m), jnp.float64)
+    LUb, pb = lu_factor_blocked(A, block=16)
+    LUu, pu = lu_factor_unblocked(A)
+    np.testing.assert_array_equal(np.asarray(pb), np.asarray(pu))
+    scale = float(jnp.max(jnp.abs(LUu)))
+    assert float(jnp.max(jnp.abs(LUb - LUu))) <= 1e-10 * scale
+
+
+@pytest.mark.slow
+def test_refined_solve_grad_matches_fd():
+    """grad through the ``custom_vjp`` refined solve — now backed by the
+    blocked factorization — against central finite differences (slow
+    tier, like the geometry-to-coefficients FD pin
+    tests/test_jax_bem.py::test_grad_matches_finite_difference:
+    tracing the adjoint dominates)."""
+    import jax
+    import jax.numpy as jnp
+
+    from raft_tpu.hydro.jax_bem import _solve_refined
+
+    rng = np.random.default_rng(11)
+    M0 = jnp.asarray(rng.normal(size=(40, 40)) + 3 * np.eye(40))
+    B0 = jnp.asarray(rng.normal(size=(40, 2)))
+
+    def loss(t):
+        return jnp.sum(_solve_refined(M0 + t * jnp.eye(40), B0) ** 2)
+
+    g = float(jax.grad(loss)(jnp.float64(0.0)))
+    eps = 1e-6
+    fd = (float(loss(jnp.float64(eps)))
+          - float(loss(jnp.float64(-eps)))) / (2 * eps)
+    assert g == pytest.approx(fd, rel=1e-6)
+
+
+# ------------------------------------------------------- knobs + salting
+
+def test_assembly_knob_parsing(monkeypatch):
+    from raft_tpu.hydro import jax_bem
+
+    monkeypatch.delenv(jax_bem.ASSEMBLY_ENV, raising=False)
+    assert jax_bem.assembly_mode() == "auto"
+    for raw, want in [("pallas", "pallas"), (" XLA ", "xla"),
+                      ("auto", "auto"), ("", "auto"), ("bogus", "auto")]:
+        monkeypatch.setenv(jax_bem.ASSEMBLY_ENV, raw)
+        assert jax_bem.assembly_mode() == want
+    # auto resolves per backend: the CPU suite takes the XLA route
+    monkeypatch.setenv(jax_bem.ASSEMBLY_ENV, "auto")
+    assert jax_bem.resolved_assembly() == "xla"
+    assert jax_bem.resolved_assembly("pallas") == "pallas"
+    # an EXPLICIT 'auto' defers to the env knob (the resolved_mode
+    # override contract)
+    monkeypatch.setenv(jax_bem.ASSEMBLY_ENV, "pallas")
+    assert jax_bem.resolved_assembly("auto") == "pallas"
+    monkeypatch.delenv(jax_bem.ASSEMBLY_ENV)
+    assert jax_bem.resolved_assembly("auto") == "xla"
+
+
+def test_precision_knob_parsing(monkeypatch):
+    from raft_tpu.hydro import jax_bem
+
+    monkeypatch.delenv(jax_bem.PRECISION_ENV, raising=False)
+    assert jax_bem.bem_precision() == "f32"
+    for raw, want in [("bf16", "bf16"), ("BFLOAT16", "bf16"),
+                      ("f32", "f32"), ("float32", "f32"), ("", "f32"),
+                      ("f16", "f32")]:   # unsupported degrades, warned once
+        monkeypatch.setenv(jax_bem.PRECISION_ENV, raw)
+        assert jax_bem.bem_precision() == want
+
+
+def test_assembly_and_precision_are_key_salted():
+    """An assembly-route or precision flip must change every AOT key:
+    the routes agree only to INTERP_PARITY_RTOL, not bitwise, and bf16
+    coefficients differ at bf16 scale."""
+    from raft_tpu.cache.aot import _solver_salts
+
+    salts = _solver_salts()
+    assert "bem_assembly" in salts
+    assert salts[salts.index("bem_assembly") + 1] in ("xla", "pallas")
+    assert "bem_precision" in salts
+    assert salts[salts.index("bem_precision") + 1] in ("f32", "bf16")
+
+
+def test_tile_ok_matches_ladder():
+    from raft_tpu.build import buckets
+    from raft_tpu.core import pallas_bem
+
+    for c in buckets.DEFAULT_LADDER["panels"]:
+        assert pallas_bem.tile_ok(c)          # built-in ladder is aligned
+    assert not pallas_bem.tile_ok(96)         # custom class -> XLA route
+    assert not pallas_bem.tile_ok(32)
+    assert pallas_bem.TILE == buckets.BEM_TILE
+
+
+# ------------------------------------------- cross-route assembly parity
+
+def _tile_mesh():
+    """~60 hull panels -> the 64 ``panels`` class (tile-aligned)."""
+    from raft_tpu.hydro.mesh import mesh_member
+
+    return mesh_member(stations=[0.0, 8.0], diameters=[2.3, 2.3],
+                       rA=[0.0, 0.0, -6.0], rB=[0.0, 0.0, 2.0],
+                       dz_max=1.6, da_max=1.3)
+
+
+def _solve_args(w, depth):
+    import jax.numpy as jnp
+
+    from raft_tpu.hydro import jax_bem, wavetable
+
+    padded, pm, lm = jax_bem._pad_mesh(_tile_mesh(), None)
+    fd = wavetable.fd_fit_grid(w, depth if depth > 0 else -1.0, 9.81)
+    tab = jax_bem._stage_table(jnp.float32)
+    return (jnp.asarray(padded, jnp.float32), jnp.asarray(pm, jnp.float32),
+            jnp.asarray(lm, jnp.float32), jnp.asarray(w, jnp.float32),
+            jnp.asarray([depth], jnp.float32),
+            {k: jnp.asarray(v, jnp.float32) for k, v in fd.items()}, tab)
+
+
+@pytest.mark.slow
+@pytest.mark.parametrize("depth", [0.0, 35.0])
+def test_xla_vs_pallas_interpret_parity(depth):
+    """The dual-route pin (PR 3 precedent): identical math, different
+    tiling — XLA vs pallas-interpret within INTERP_PARITY_RTOL on A, B
+    and F, deep (region-split wave integrals + Bessel far field) and
+    finite depth (the 4-image exp-fit branch)."""
+    from raft_tpu.core.pallas_bem import INTERP_PARITY_RTOL
+    from raft_tpu.hydro import jax_bem
+
+    args = _solve_args(W2, depth)
+    kw = dict(finite_depth=depth > 0, depth=depth, dtype=None)
+    Ax, Bx, Fx, rx = jax_bem.solve_panels(*args, assembly="xla", **kw)
+    Ap, Bp, Fp, rp = jax_bem.solve_panels(*args, assembly="pallas", **kw)
+    for name, x, p in [("A", Ax, Ap), ("B", Bx, Bp),
+                       ("F.re", Fx.re, Fp.re), ("F.im", Fx.im, Fp.im)]:
+        err = jax_bem.parity_err(np.asarray(p), np.asarray(x))
+        assert err <= INTERP_PARITY_RTOL, (
+            f"{name} (depth={depth}): {err:.2e} > {INTERP_PARITY_RTOL:.0e}")
+    assert float(np.max(rp)) < 1e-4 and float(np.max(rx)) < 1e-4
+
+
+@pytest.mark.slow
+def test_bf16_assembly_guarded_by_residual():
+    """The mixed-precision mode: bf16 assembly + f32 factor/refinement
+    stays finite, its refinement residual (THE guardrail metric) stays
+    small, and the coefficients track the f32 route at bf16 resolution
+    — loose by design; the knob is opt-in and key-salted."""
+    from raft_tpu.hydro import jax_bem
+
+    args = _solve_args(W2, 0.0)
+    kw = dict(finite_depth=False, depth=0.0, dtype=None)
+    A1, B1, F1, r1 = jax_bem.solve_panels(*args, assembly="xla", **kw)
+    A2, B2, F2, r2 = jax_bem.solve_panels(*args, assembly="xla",
+                                          precision="bf16", **kw)
+    for x in (A2, B2, F2.re, F2.im):
+        assert np.isfinite(np.asarray(x)).all()
+    assert float(np.max(r2)) < 1e-4           # refinement holds the line
+    assert jax_bem.parity_err(np.asarray(A2), np.asarray(A1)) < 0.1
+
+
+def test_non_tile_aligned_falls_back(monkeypatch):
+    """A non-TILE-multiple padded class must take the XLA route even
+    when the knob says pallas — routing, not a crash (custom
+    RAFT_TPU_BUCKETS ladders stay supported)."""
+    from raft_tpu.core import pallas_bem
+
+    with pytest.raises(ValueError, match="multiple"):
+        pallas_bem.rankine_assembly(np.zeros((96, 4, 3)), *([None] * 7))

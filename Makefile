@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke serve-smoke bem-smoke lint lint-budgets
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke spmd-smoke serve-smoke bem-smoke lint lint-budgets
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -30,6 +30,9 @@ obs-smoke:       ## observability proof: RAFT_TPU_OBS-armed sweep emits valid
 
 race-smoke:      ## deterministic N-thread race proof: single-flight AOT compile,
 	python -m raft_tpu.lint.race     # exact metric/ckpt/fault counters (< 60 s CPU)
+
+spmd-smoke:      ## deterministic 2-process SPMD proof: design axis sharded over a
+	python -m raft_tpu.parallel.spmd_smoke   # global mesh == unsharded oracle; one shared cache root, per-process-salted exports, no torn files (< 90 s CPU)
 
 serve-smoke:     ## resident-daemon proof: compiles == buckets, solo parity, warm
 	python -m raft_tpu.serve smoke   # restart 0 compiles; armed obs leg: request traces/SLO/flight/ledger

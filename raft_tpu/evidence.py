@@ -93,6 +93,11 @@ def main():
     gj = (lint.get("json") or {}).get("gl3xx")
     if gj is not None:
         lint["gl3xx"] = gj
+    # SPMD-contract summary (GL401-GL404 new/triaged counts): the
+    # pod-readiness gate, same one-key-deep treatment
+    g4 = (lint.get("json") or {}).get("gl4xx")
+    if g4 is not None:
+        lint["gl4xx"] = g4
     evidence["lint"] = lint
 
     print("[evidence] serve-smoke (resident daemon cross-process) ...",

@@ -309,6 +309,117 @@ def check_budget(name: str, metrics: dict | None, budgets: dict,
     return ok, notes
 
 
+#: devices in the forced virtual CPU mesh the sharded-lowering gate runs
+#: on — matches the test session's virtual device count and the SPMD
+#: smoke's global mesh (4 local devices x 2 processes)
+SHARDED_MESH_DEVICES = 8
+#: the sharded-lowering bound: per-device peak under the batch-sharded
+#: lowering must not exceed 1/N of the replicated lowering's per-device
+#: peak by more than this fraction (padding, replicated small operands,
+#: and partitioner bookkeeping live inside the slack)
+SHARDED_TOLERANCE = 0.25
+#: lanes per device the gate tiles each entry's batch up to before
+#: lowering: at 1 lane/device the per-device FIXED footprint (closure
+#: constants, scan bookkeeping) swamps the batch term the bound is
+#: about; at 8 the batch-proportional memory dominates and the 1/N
+#: scaling claim is actually measurable
+SHARDED_MIN_LANES_PER_DEVICE = 16
+
+
+def _sharded_mesh(axis: str = "batch"):
+    """The forced virtual CPU mesh the sharded gate lowers on —
+    :func:`raft_tpu.parallel.spmd.forced_cpu_mesh`, the same construction
+    the SPMD smoke and the driver dry run use, so device count and axis
+    name cannot drift between them."""
+    from raft_tpu.parallel import spmd
+
+    _, mesh = spmd.forced_cpu_mesh(SHARDED_MESH_DEVICES, axis=axis)
+    return mesh
+
+
+def sharded_metrics(entry, mesh) -> dict:
+    """Dual-lower one ``sharded=True`` entry over ``mesh`` (x32) and
+    return the sharded-gate metric block.
+
+    The entry's batch-leading leaves (leading dim == the first array
+    leaf's) are tiled to a mesh-divisible lane count, then the SAME
+    argument set is AOT-lowered twice: once fully replicated, once with
+    the batch axis sharded over the mesh.  ``memory_analysis`` sizes are
+    PER-DEVICE, so the pair pins the claim that matters on a pod: a
+    batch-sharded dispatch holds ~1/N of the replicated footprint per
+    device — an executable that silently materializes the full batch on
+    every device (a lost sharding annotation, a gather the partitioner
+    inserted) breaks ``sharded_peak_bytes`` against its committed budget
+    AND the ratio bound in :func:`check_sharded`."""
+    import math
+
+    import jax
+    import jax.numpy as jnp
+    from jax.experimental import disable_x64
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    axis = mesh.axis_names[0]
+    n = int(mesh.devices.size)
+    with disable_x64():
+        fn, args, _ = entry.build()
+        leaves, treedef = jax.tree_util.tree_flatten(args)
+        batch = next(l.shape[0] for l in leaves
+                     if getattr(l, "ndim", 0) >= 1)
+        # tile whole batches up to >= SHARDED_MIN_LANES_PER_DEVICE * n
+        # lanes while keeping the count a multiple of both the batch and
+        # the mesh size
+        base = math.lcm(batch, n)
+        k = max(1, -(-(SHARDED_MIN_LANES_PER_DEVICE * n) // base))
+        reps = base * k // batch
+        tiled, specs = [], []
+        for leaf in leaves:
+            if getattr(leaf, "ndim", 0) >= 1 and leaf.shape[0] == batch:
+                tiled.append(jnp.concatenate([leaf] * reps, axis=0)
+                             if reps > 1 else leaf)
+                specs.append(P(axis))
+            else:
+                tiled.append(leaf)
+                specs.append(P())
+        targs = jax.tree_util.tree_unflatten(treedef, tiled)
+
+        def lower(spec_list):
+            sh = jax.tree_util.tree_unflatten(
+                treedef, [NamedSharding(mesh, s) for s in spec_list])
+            return jax.jit(fn, in_shardings=sh).lower(*targs).compile()
+
+        rep = compiled_metrics(lower([P()] * len(specs)), 0, 0)
+        shd = compiled_metrics(lower(specs), 0, 0)
+    out = {"sharded_mesh_devices": n,
+           "sharded_batch_lanes": int(batch * reps)}
+    if "peak_bytes" in rep:
+        out["replicated_peak_bytes"] = rep["peak_bytes"]
+    if "peak_bytes" in shd:
+        out["sharded_peak_bytes"] = shd["peak_bytes"]
+    return out
+
+
+def check_sharded(name: str, metrics: dict | None) -> tuple:
+    """(ok, notes) of one sharded entry's ratio bound: per-device peak
+    under the batch-sharded lowering <= replicated / mesh_size x
+    (1 + SHARDED_TOLERANCE).  Missing metrics fail — a gate that stops
+    measuring is no gate."""
+    m = metrics or {}
+    rep, shd = m.get("replicated_peak_bytes"), m.get("sharded_peak_bytes")
+    n = m.get("sharded_mesh_devices")
+    if not rep or shd is None or not n:
+        return False, [f"sharded gate: entry {name!r} is sharded=True but "
+                       "the dual lowering produced no peak_bytes pair — "
+                       "the per-device bound cannot be verified"]
+    bound = rep / n * (1.0 + SHARDED_TOLERANCE)
+    if shd > bound:
+        return False, [
+            f"sharded_peak_bytes {shd} exceeds replicated/{n} x "
+            f"{1.0 + SHARDED_TOLERANCE:.2f} = {bound:.0f} (replicated "
+            f"{rep}) — the batch-sharded lowering is materializing "
+            f"(nearly) the full batch per device"]
+    return True, []
+
+
 def audit_entry(entry, retrace_check: bool = True,
                 collect_metrics: bool = False) -> AuditReport:
     """Run all budgets for one registry entry **in x32 mode**."""
@@ -352,24 +463,42 @@ def run_audit(names=None, retrace_check: bool = True,
 
     from raft_tpu.lint.registry import get_entries
 
+    entries = get_entries(names)
+    # force the virtual mesh BEFORE the first entry builds (backend init
+    # order: the mesh setup must land before jax stages any arrays)
+    mesh = (_sharded_mesh() if budget_check
+            and any(e.sharded for e in entries) else None)
     reports = [audit_entry(e, retrace_check=retrace_check,
                            collect_metrics=budget_check)
-               for e in get_entries(names)]
+               for e in entries]
     if budget_check:
         budgets = load_budgets(budgets_path)
         platform = jax.default_backend()
-        for r in reports:
-            r.budget_ok, r.budget_notes = check_budget(
+        for e, r in zip(entries, reports):
+            sh_ok, sh_notes = True, []
+            if e.sharded:
+                r.metrics = {**(r.metrics or {}),
+                             **sharded_metrics(e, mesh)}
+                sh_ok, sh_notes = check_sharded(r.name, r.metrics)
+            r.budget_ok, notes = check_budget(
                 r.name, r.metrics, budgets, platform)
+            r.budget_ok = r.budget_ok and sh_ok
+            r.budget_notes.extend(sh_notes + notes)
             r.ok = r.ok and r.budget_ok
     return reports
 
 
 def write_budgets(names=None, path: str | None = None) -> tuple:
-    """Collect metrics for the named entries (default: all) and merge
+    """Collect metrics for the named entries (default: all), including
+    the sharded-lowering pair for ``sharded=True`` entries, and merge
     them into the budgets file.  Returns (path, reports)."""
     from raft_tpu.lint.registry import get_entries
 
+    entries = get_entries(names)
+    mesh = (_sharded_mesh() if any(e.sharded for e in entries) else None)
     reports = [audit_entry(e, retrace_check=False, collect_metrics=True)
-               for e in get_entries(names)]
+               for e in entries]
+    for e, r in zip(entries, reports):
+        if e.sharded:
+            r.metrics = {**(r.metrics or {}), **sharded_metrics(e, mesh)}
     return save_budgets(reports, path), reports

@@ -35,6 +35,22 @@ def repo_root() -> str:
         os.path.abspath(__file__))))
 
 
+def _arm_audit_env() -> None:
+    """Pin the audit's backend BEFORE anything initializes jax: CPU (the
+    audit is a structural gate, not a perf run — no hardware required)
+    with the virtual device count the sharded-lowering gate shards over.
+    XLA parses its flags exactly once per process, so this must land
+    ahead of the first ``jax.devices()`` anywhere; in-process callers
+    that already initialized jax are handled by
+    :func:`raft_tpu.parallel.spmd.force_cpu_devices` instead."""
+    from raft_tpu.lint.audit import SHARDED_MESH_DEVICES
+    from raft_tpu.parallel.spmd import with_host_device_flag
+
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ["XLA_FLAGS"] = with_host_device_flag(
+        os.environ.get("XLA_FLAGS", ""), SHARDED_MESH_DEVICES)
+
+
 def main(argv=None) -> int:
     ap = argparse.ArgumentParser(
         prog="python -m raft_tpu.lint",
@@ -81,7 +97,7 @@ def main(argv=None) -> int:
 
     if args.write_budgets:
         # budget refresh is its own mode: lower + measure, save, done
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _arm_audit_env()
         from raft_tpu.lint.audit import write_budgets
 
         names = (args.audit_entries.split(",")
@@ -143,14 +159,26 @@ def main(argv=None) -> int:
             print("[graftlint] gl3xx: " + "  ".join(
                 f"{r}={c['new']} new/{c['triaged']} triaged"
                 for r, c in gl3.items()))
+            # SPMD-contract summary (GL4xx): the pod-readiness gate, same
+            # shape as gl3xx — one key deep here and in EVIDENCE.json
+            gl4_rules = sorted(r for r in RULES if r.startswith("GL4"))
+            gl4 = {}
+            for r in gl4_rules:
+                n_new = sum(1 for v in fresh if v.rule == r)
+                n_total = sum(1 for v in violations if v.rule == r)
+                gl4[r] = {"new": n_new, "triaged": n_total - n_new}
+            summary["gl4xx"] = {
+                "rules": gl4,
+                "ok": all(c["new"] == 0 for c in gl4.values()),
+            }
+            print("[graftlint] gl4xx: " + "  ".join(
+                f"{r}={c['new']} new/{c['triaged']} triaged"
+                for r, c in gl4.items()))
             if fresh:
                 rc = 1
 
     if (args.audit or args.audit_only) and not args.write_baseline:
-        # the audit is a structural gate, not a perf run: default it onto
-        # CPU (the test-suite convention — no hardware required) unless
-        # the caller pinned a platform explicitly
-        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+        _arm_audit_env()
         from raft_tpu.lint.audit import run_audit
 
         names = (args.audit_entries.split(",")

@@ -48,6 +48,16 @@ class EntryPoint:
     #: ``concurrent=True`` entry's ``public_api`` must join — pinned by a
     #: drift test, like the knobs table)
     concurrent: bool = False
+    #: pod-facing: the public API this entry mirrors runs on the ROADMAP
+    #: multi-host sweep path, so its host path falls under the GL4xx SPMD
+    #: contracts (GL401/GL402/GL403 seeds come from
+    #: :data:`MULTIHOST_FUNCTIONS`)
+    multihost: bool = False
+    #: the entry's first argument is batch-leading and the sharded-lowering
+    #: audit gate must lower it with the batch axis sharded over the forced
+    #: 8-device CPU mesh (per-device peak_bytes pinned in budgets.json);
+    #: a drift test pins multihost => sharded
+    sharded: bool = False
 
 
 def _small_base(nw: int = 6):
@@ -408,22 +418,29 @@ def _entry_eigen():
 
 ENTRY_POINTS: tuple[EntryPoint, ...] = (
     EntryPoint("north_star_sweep", "raft_tpu.parallel.sweep.sweep",
-               _entry_north_star_sweep, concurrent=True),
+               _entry_north_star_sweep, concurrent=True, multihost=True,
+               sharded=True),
     EntryPoint("dlc_solve", "raft_tpu.parallel.sweep.sweep_sea_states",
-               _entry_dlc_solve, concurrent=True),
+               _entry_dlc_solve, concurrent=True, multihost=True, sharded=True),
     EntryPoint("freq_sharded_forward",
                "raft_tpu.parallel.sweep.forward_response_freq_sharded",
                _entry_freq_sharded),
     EntryPoint("val_grad", "raft_tpu.parallel.optimize.optimize_design",
                _entry_val_grad),
     EntryPoint("eigen", "raft_tpu.solve.eigen.solve_eigen", _entry_eigen),
+    # NOT sharded: the fused kernel is the per-shard body — production
+    # runs it INSIDE a shard_map shard, never sharded across the
+    # frequency batch (a pallas_call forces the partitioner to gather
+    # its whole operand, so a batch-sharded lowering of this entry
+    # measures an all-gather, not a sharding regression)
     EntryPoint("fused_rao_solve",
                "raft_tpu.core.pallas6.solve_rao_pallas",
                _entry_fused_rao_solve),
     EntryPoint("sweep_designs", "raft_tpu.parallel.sweep.sweep_designs",
-               _entry_sweep_designs, concurrent=True),
+               _entry_sweep_designs, concurrent=True, multihost=True,
+               sharded=True),
     EntryPoint("serve_solve", "raft_tpu.serve.solver.solve_batch",
-               _entry_serve_solve, concurrent=True),
+               _entry_serve_solve, concurrent=True, multihost=True, sharded=True),
     EntryPoint("jax_bem", "raft_tpu.hydro.jax_bem.solve_panels",
                _entry_jax_bem),
     EntryPoint("jax_bem_pallas", "raft_tpu.hydro.jax_bem.solve_panels",
@@ -444,6 +461,23 @@ CONCURRENT_FUNCTIONS: tuple[str, ...] = tuple(
 ) + (
     "raft_tpu.cache.aot.cached_compile",
     "raft_tpu.cache.aot.cached_callable",
+)
+
+#: the pod-facing host functions whose whole call path falls under the
+#: GL4xx SPMD contracts — graftlint seeds its multihost reachability here
+#: (GL401 host-agreement, GL402 shared-root writes, GL403 sharding
+#: discipline).  Every ``multihost=True`` audit entry's ``public_api`` is
+#: included automatically; the explicit extras are the multi-host staging
+#: and mesh-sharded forward paths that run on every host of a pod even
+#: though no audit entry dispatches them directly.  Names must resolve to
+#: real callables AND be listed in the docs "SPMD contracts" section
+#: (``tests/test_lint.py`` drift-pins both directions).
+MULTIHOST_FUNCTIONS: tuple[str, ...] = tuple(
+    e.public_api for e in ENTRY_POINTS if e.multihost
+) + (
+    "raft_tpu.parallel.multihost.stage_global",
+    "raft_tpu.parallel.sweep.forward_response_freq_sharded",
+    "raft_tpu.parallel.sweep.forward_response_dp_sp",
 )
 
 

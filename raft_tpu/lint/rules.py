@@ -75,6 +75,35 @@ GL303    env-read-in-          an env-knob read inside code reachable from a
                                time — a mid-process env change silently
                                diverges behavior from the AOT key it was
                                salted into
+GL401    host-divergent-       a host-divergent value (env read, wall
+         control-flow          clock, random, hostname, pid,
+                               ``jax.process_index()``) steering a branch/
+                               loop that reaches an SPMD dispatch in code
+                               reachable from a *multihost* entry point:
+                               all hosts must execute the same program in
+                               the same order, or the collective deadlocks
+                               the pod (key-salted ``aot_key`` knobs pass —
+                               the GL303 triage precedent)
+GL402    shared-root-write-    a write under a durable cache/ckpt/obs/
+         collision             ledger root, reachable from a multihost
+                               entry, whose filename is neither salted by
+                               ``jax.process_index()`` nor serialized
+                               under a lock: two hosts sharing the root
+                               clobber each other (a pid-only suffix does
+                               NOT pass — pids collide across hosts)
+GL403    unsharded-large-      a batched dispatch (``jit(vmap(f))`` /
+         operand               ``cached_*(tag, vmap(f), ...)``) on a
+                               multihost path with no ``in_shardings``/
+                               ``mesh=``, or a closure-captured large
+                               constant not routed through ``consts=`` —
+                               both replicate per device instead of
+                               sharding the batch axis
+GL404    mesh-axis-contract    an axis name in ``PartitionSpec``/``psum``/
+                               ``shard_map`` that no ``Mesh`` in the repo
+                               declares (typo'd axes fail at dispatch
+                               time, on the pod), or a collective placed
+                               lexically inside a host-conditional branch
+                               (only some hosts enter it: deadlock)
 =======  ====================  ==============================================
 
 Reachability: a function is *jit-reachable* when it is decorated with (or
@@ -118,6 +147,10 @@ RULES = {
     "GL301": "unlocked-global-mutation",
     "GL302": "check-then-act-memo",
     "GL303": "env-read-in-concurrent-path",
+    "GL401": "host-divergent-control-flow",
+    "GL402": "shared-root-write-collision",
+    "GL403": "unsharded-large-operand",
+    "GL404": "mesh-axis-contract",
 }
 
 # ---------------------------------------------------------------- GL3xx --
@@ -138,6 +171,36 @@ _MUTATOR_METHODS = {"append", "appendleft", "extend", "extendleft",
 #: for GL303 (the in-file analog of ``lint/registry.py``'s
 #: ``CONCURRENT_FUNCTIONS`` — a daemon module declares its own handlers)
 CONCURRENT_DECL = "__graftlint_concurrent__"
+
+#: module-level declaration marking functions as multi-host entry points
+#: for GL401/GL402/GL403 (the in-file analog of ``lint/registry.py``'s
+#: ``MULTIHOST_FUNCTIONS`` — code on the pod-scale sweep path)
+MULTIHOST_DECL = "__graftlint_multihost__"
+
+# ---------------------------------------------------------------- GL4xx --
+# cross-device collective primitives (jax.lax namespace): every host must
+# reach these in the same order, which is the whole GL401/GL404 contract
+_COLLECTIVE_FNS = {"psum", "pmax", "pmin", "pmean", "all_gather",
+                   "all_to_all", "ppermute", "pshuffle", "psum_scatter",
+                   "axis_index"}
+
+# calls whose result salts a filename per HOST (pid alone does not — pids
+# collide across hosts, which is exactly what GL402 exists to catch)
+_PROCESS_SALT_FNS = {"process_index", "process_tag"}
+
+# host-divergent value sources for GL401/GL404: (module, attr names).
+# Any env read counts too (handled separately, with the aot_key-knob
+# exemption per the GL303 triage precedent).
+_DIVERGENT_TIME_FNS = {"time", "time_ns", "perf_counter", "monotonic",
+                       "process_time"}
+_DIVERGENT_HOST_FNS = {"gethostname", "getfqdn", "node", "getpid",
+                       "process_index"}
+
+# array constructors whose literal-shape product decides whether a
+# closure-captured constant is "large" for GL403 (replicates per device)
+_BIG_ARRAY_CTORS = {"zeros", "ones", "full", "empty", "arange",
+                    "linspace"}
+_BIG_CONST_ELEMS = 4096
 
 # the AOT registry's compile entry points: a function handed to one of
 # these is traced and compiled exactly like a jax.jit target (GL1xx
@@ -232,6 +295,8 @@ class FuncInfo:
     is_root: bool = False
     reachable: bool = False
     concurrent: bool = False      # reachable from a concurrent entry point
+    multihost: bool = False       # reachable from a multihost entry point
+    spmd: bool = False            # contains or reaches a collective/dispatch
 
 
 class ModuleInfo:
@@ -268,6 +333,7 @@ class ModuleInfo:
         # points (GL303 seeds)
         self.mutable_globals: set[str] = set()
         self.concurrent_decls: tuple = ()
+        self.multihost_decls: tuple = ()
         self._collect_suppressions()
         self._collect_imports()
         for node in self.tree.body:
@@ -299,6 +365,12 @@ class ModuleInfo:
                 continue
             if CONCURRENT_DECL in names:
                 self.concurrent_decls = tuple(
+                    n.value for n in ast.walk(value)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, str))
+                continue
+            if MULTIHOST_DECL in names:
+                self.multihost_decls = tuple(
                     n.value for n in ast.walk(value)
                     if isinstance(n, ast.Constant)
                     and isinstance(n.value, str))
@@ -508,6 +580,62 @@ class ModuleInfo:
                     and (tgt[1] or fn.id) in _CACHED_COMPILE_FNS)
         return False
 
+    # -- GL4xx classification -------------------------------------------
+    def collective_call(self, call: ast.Call) -> str | None:
+        """The primitive name when ``call`` is a cross-device collective
+        (``jax.lax.psum``/``lax.pmax``/bare ``psum`` imported from
+        ``jax.lax``), else None."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute) and fn.attr in _COLLECTIVE_FNS:
+            base = fn.value
+            if isinstance(base, ast.Name) and (
+                    base.id in self.lax_aliases
+                    or base.id in self.jax_aliases):
+                return fn.attr
+            if isinstance(base, ast.Attribute) and base.attr == "lax" \
+                    and self.is_jax(base.value):
+                return fn.attr
+            return None
+        if isinstance(fn, ast.Name):
+            tgt = self.import_map.get(fn.id)
+            if tgt is not None and tgt[0].startswith("jax") \
+                    and (tgt[1] or fn.id) in _COLLECTIVE_FNS:
+                return tgt[1] or fn.id
+        return None
+
+    def sharded_dispatch(self, call: ast.Call) -> str | None:
+        """A label when ``call`` dispatches an SPMD program — the sites
+        every host must reach in lockstep: ``shard_map``/``pmap``, a
+        ``jit`` carrying ``in_shardings``/``out_shardings``, a registry
+        compile carrying ``mesh=``, or ``with_sharding_constraint``."""
+        t = self.transform_of(call.func)
+        if t in ("shard_map", "pmap"):
+            return t
+        kws = {kw.arg for kw in call.keywords}
+        if t == "jit" and kws & {"in_shardings", "out_shardings"}:
+            return "sharded jit"
+        if self.cached_compile_call(call) and "mesh" in kws:
+            return "mesh-keyed registry compile"
+        fn = call.func
+        nm = (fn.attr if isinstance(fn, ast.Attribute)
+              else fn.id if isinstance(fn, ast.Name) else None)
+        if nm == "with_sharding_constraint":
+            return "with_sharding_constraint"
+        return None
+
+    def partition_spec_call(self, call: ast.Call) -> bool:
+        """True for ``PartitionSpec(...)`` / ``P(...)`` (the conventional
+        alias, resolved through the import map)."""
+        fn = call.func
+        if isinstance(fn, ast.Attribute):
+            return fn.attr == "PartitionSpec"
+        if isinstance(fn, ast.Name):
+            if fn.id == "PartitionSpec":
+                return True
+            tgt = self.import_map.get(fn.id)
+            return tgt is not None and tgt[1] == "PartitionSpec"
+        return False
+
 
 def _attr_root(node: ast.Attribute) -> ast.AST:
     while isinstance(node, ast.Attribute):
@@ -518,6 +646,16 @@ def _attr_root(node: ast.Attribute) -> ast.AST:
 def _attr_root_name(node: ast.AST) -> str | None:
     root = _attr_root(node) if isinstance(node, ast.Attribute) else node
     return root.id if isinstance(root, ast.Name) else None
+
+
+def _terminal_name(node: ast.AST) -> str | None:
+    """The terminal identifier of a call target: ``f`` for both ``f(...)``
+    and ``mod.sub.f(...)``."""
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
 
 
 def _param_names(args: ast.arguments) -> list[str]:
@@ -843,10 +981,14 @@ class Analyzer:
     def run(self) -> list[Violation]:
         self.propagate()
         self._propagate_concurrent()
+        self._propagate_multihost()
+        self._propagate_spmd()
+        declared_axes = self._declared_axes()
         for mod in self.modules.values():
             self._check_module_wide(mod)
             self._check_contracts(mod)
             self._check_concurrency(mod)
+            self._check_spmd(mod, declared_axes)
             for fi in mod.functions.values():
                 if fi.reachable:
                     self._check_traced_function(fi)
@@ -1179,6 +1321,611 @@ class Analyzer:
                     hit = m3.functions.get(node.attr)
                     if hit is not None:
                         yield hit
+
+    # ---- SPMD contract rules: GL401, GL402, GL403, GL404 ----
+    def _propagate_multihost(self) -> None:
+        """Mark every function host-reachable from a registered multihost
+        entry point (the pod-scale sweep path).  Seeds come from
+        ``lint/registry.py``'s ``MULTIHOST_FUNCTIONS`` (dotted names) and
+        from in-module ``__graftlint_multihost__`` declarations; edges are
+        the concurrent propagation's — bare-name references plus
+        module-attribute calls resolved through the import map."""
+        roots: set = set()
+        try:
+            from raft_tpu.lint import registry as _registry
+
+            roots.update(getattr(_registry, "MULTIHOST_FUNCTIONS", ()))
+        except Exception:       # linting outside the package install
+            pass
+        work: list[FuncInfo] = []
+
+        def mark(fi: FuncInfo | None) -> None:
+            if fi is not None and not fi.multihost:
+                fi.multihost = True
+                work.append(fi)
+
+        for dotted_mod, mod in self.modules.items():
+            for fname in mod.multihost_decls:
+                mark(mod.functions.get(fname))
+            for r in roots:
+                if r.startswith(dotted_mod + "."):
+                    mark(mod.functions.get(r[len(dotted_mod) + 1:]))
+        while work:
+            fi = work.pop()
+            for callee in self._referenced_functions(fi):
+                mark(callee)
+            for callee in self._attr_referenced_functions(fi):
+                mark(callee)
+
+    def _propagate_spmd(self) -> None:
+        """Mark every function that CONTAINS a collective / SPMD-dispatch
+        site, then propagate caller-ward to a fixpoint: a function that
+        calls an spmd function is itself a site every host must reach in
+        the same order (what GL401's divergent-branch check keys on)."""
+        for mod in self.modules.values():
+            for fi in mod.functions.values():
+                for node in self._own_body_walk(fi):
+                    if isinstance(node, ast.Call) and (
+                            mod.collective_call(node)
+                            or mod.sharded_dispatch(node)):
+                        fi.spmd = True
+                        break
+        all_funcs = [fi for mod in self.modules.values()
+                     for fi in mod.functions.values()]
+        changed = True
+        while changed:
+            changed = False
+            for fi in all_funcs:
+                if fi.spmd:
+                    continue
+                for callee in self._referenced_functions(fi):
+                    if callee.spmd:
+                        fi.spmd = changed = True
+                        break
+                if not fi.spmd:
+                    for callee in self._attr_referenced_functions(fi):
+                        if callee.spmd:
+                            fi.spmd = changed = True
+                            break
+
+    def _declared_axes(self) -> set[str]:
+        """Every mesh axis name declared ANYWHERE in the linted set:
+        ``Mesh(..., axis_names=(...))`` literals plus string defaults of
+        ``axis``/``axis_name``/``axis_names`` parameters (the
+        ``make_mesh(axis="designs")`` convention).  Repo-wide on purpose —
+        meshes are built in one module and consumed in another; the bug
+        GL404 exists for is an axis name declared NOWHERE (a typo that
+        only fails at dispatch time, on the pod)."""
+        axes: set[str] = set()
+        for mod in self.modules.values():
+            for node in ast.walk(mod.tree):
+                if isinstance(node, ast.Call):
+                    fn = node.func
+                    nm = (fn.attr if isinstance(fn, ast.Attribute)
+                          else fn.id if isinstance(fn, ast.Name) else None)
+                    if nm not in ("Mesh", "global_mesh", "make_mesh",
+                                  "forced_cpu_mesh"):
+                        continue
+                    for sub in list(node.args) + [k.value
+                                                  for k in node.keywords]:
+                        for n in ast.walk(sub):
+                            if isinstance(n, ast.Constant) and isinstance(
+                                    n.value, str):
+                                axes.add(n.value)
+                elif isinstance(node, (ast.FunctionDef,
+                                       ast.AsyncFunctionDef)):
+                    args = node.args
+                    pos = args.posonlyargs + args.args
+                    named = dict(zip(
+                        [a.arg for a in pos[len(pos)
+                                            - len(args.defaults):]],
+                        args.defaults))
+                    named.update({a.arg: d for a, d in
+                                  zip(args.kwonlyargs, args.kw_defaults)
+                                  if d is not None})
+                    for pname, d in named.items():
+                        if pname in ("axis", "axis_name", "axis_names"):
+                            for n in ast.walk(d):
+                                if isinstance(n, ast.Constant) and \
+                                        isinstance(n.value, str):
+                                    axes.add(n.value)
+        return axes
+
+    def _divergence_source(self, mod: ModuleInfo, expr: ast.AST,
+                           tainted: set[str]) -> str | None:
+        """A description when ``expr`` carries a host-divergent value —
+        one that can differ BETWEEN the hosts of one pod: an env read
+        (``aot_key``-classified knobs pass: key-salted reads move the
+        program WITH the value, the GL303 triage precedent), wall clock,
+        random, hostname, pid, ``jax.process_index()``, or a name tainted
+        by any of those."""
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in tainted:
+                return f"host-divergent value {n.id!r}"
+            name = mod.env_read_name(n)
+            if name is not None:
+                knob = _knobs.get(name)
+                if knob is not None and \
+                        knob.classification == _knobs.AOT_KEY:
+                    continue
+                return f"env read {name!r}"
+            if not isinstance(n, ast.Call):
+                continue
+            fn = n.func
+            if not isinstance(fn, ast.Attribute):
+                continue
+            base = _attr_root_name(fn)
+            if fn.attr in _DIVERGENT_TIME_FNS and base == "time":
+                return f"time.{fn.attr}()"
+            if base == "random" or (isinstance(fn.value, ast.Attribute)
+                                    and fn.value.attr == "random"):
+                return f"random.{fn.attr}()"
+            if fn.attr in ("gethostname", "getfqdn") \
+                    and base == "socket":
+                return f"socket.{fn.attr}()"
+            if fn.attr == "node" and base == "platform":
+                return "platform.node()"
+            if fn.attr == "getpid" and base in mod.os_aliases:
+                return "os.getpid()"
+            if fn.attr == "process_index" and (
+                    mod.is_jax(fn.value) or base in mod.jax_aliases):
+                return "jax.process_index()"
+        return None
+
+    def _divergent_names(self, mod: ModuleInfo, fi: FuncInfo) -> set[str]:
+        """Names in ``fi`` assigned from host-divergent expressions, to a
+        fixpoint (mirrors the GL202 durable-taint shape)."""
+        tainted: set[str] = set()
+        while True:
+            changed = False
+            for node in self._own_body_walk(fi):
+                targets: list = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                if value is None or self._divergence_source(
+                        mod, value, tainted) is None:
+                    continue
+                for t in targets:
+                    for nm in _target_names(t):
+                        if nm not in tainted:
+                            tainted.add(nm)
+                            changed = True
+            if not changed:
+                break
+        return tainted
+
+    def _check_spmd(self, mod: ModuleInfo, declared_axes: set[str]) -> None:
+        self._gl404_axes(mod, declared_axes)
+        for fi in mod.functions.values():
+            if isinstance(fi.node, ast.Lambda):
+                continue
+            self._gl404_divergent_collective(mod, fi)
+            if not fi.multihost:
+                continue
+            self._gl401_function(mod, fi)
+            self._gl402_function(mod, fi)
+            self._gl403_function(mod, fi)
+
+    def _gl401_function(self, mod: ModuleInfo, fi: FuncInfo) -> None:
+        """Host-divergent control flow steering SPMD dispatch: in a
+        multihost-reachable function, a branch/loop whose decision can
+        differ between hosts, with an SPMD dispatch (or a call into an
+        spmd function) somewhere under it.  Lexically-direct collectives
+        under a divergent branch are GL404's arm and excluded here."""
+        tainted = self._divergent_names(mod, fi)
+        qual = fi.qualname
+        for node in self._own_body_walk(fi):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                decider = node.test
+            elif isinstance(node, ast.For):
+                decider = node.iter
+            else:
+                continue
+            src = self._divergence_source(mod, decider, tainted)
+            if src is None:
+                continue
+            target = self._spmd_under(mod, fi, node)
+            if target is None:
+                continue
+            kind = type(node).__name__.lower().replace("exp", " expr")
+            self._emit(mod, "GL401", node, qual,
+                       f"`{kind}` on {src} steers {target} in {qual}(), "
+                       f"which is reachable from a multihost entry "
+                       f"point: hosts that disagree on the branch skip "
+                       f"or reorder the collective and the pod "
+                       f"deadlocks — hoist the decision to staging time "
+                       f"(identical on every host), or derive it from "
+                       f"key-salted configuration")
+
+    def _spmd_under(self, mod: ModuleInfo, fi: FuncInfo,
+                    node: ast.AST) -> str | None:
+        """A label when ``node``'s subtree dispatches SPMD work: a
+        lexical dispatch site, or a reference to a function marked
+        ``spmd`` (reaches a collective through calls)."""
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call):
+                d = mod.sharded_dispatch(n)
+                if d is not None:
+                    return f"an SPMD dispatch ({d})"
+                f2 = n.func
+                if isinstance(f2, ast.Attribute) and isinstance(
+                        f2.value, ast.Name):
+                    tgt = mod.import_map.get(f2.value.id)
+                    if tgt is not None:
+                        dotted = (tgt[0] if tgt[1] is None
+                                  else f"{tgt[0]}.{tgt[1]}")
+                        for dn, m2 in self.modules.items():
+                            if dn == dotted or dn.startswith(dotted + "."):
+                                hit = m2.functions.get(f2.attr)
+                                if hit is not None and hit.spmd:
+                                    return (f"a call into SPMD code "
+                                            f"({f2.attr}())")
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                hit = self.resolve_local(mod, fi, n.id)
+                cands = [hit] if hit is not None else \
+                    self.resolve_external(mod, n.id)
+                for c in cands:
+                    if c.spmd:
+                        return f"a call into SPMD code ({n.id}())"
+        return None
+
+    def _gl402_function(self, mod: ModuleInfo, fi: FuncInfo) -> None:
+        """Shared-root write collision: in a multihost-reachable
+        function, a write whose path derives from a durable root
+        (cache/ckpt/obs/ledger — the GL202 taint) and is neither salted
+        by ``jax.process_index()`` nor serialized under a lock.  Two
+        hosts sharing the root race the same filename; a pid-only suffix
+        does NOT pass (pids collide across hosts).  Write sites: ``open``
+        in a write mode, ``np.save*``, and atomic-write helpers (the
+        tmp+``os.replace`` publishers — atomic per file, but atomicity
+        does not serialize two hosts replacing the SAME name)."""
+        body = list(self._own_body_walk(fi))
+        durable_taint = self._durable_taint(mod, body)
+        if not durable_taint["any"]:
+            return
+        salted = self._salted_names(mod, body)
+        qual = fi.qualname
+
+        def durable(expr: ast.AST) -> bool:
+            return self._expr_durable(expr, durable_taint["names"])
+
+        def is_salted(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                        and n.id in salted:
+                    return True
+                if isinstance(n, ast.Call) and _terminal_name(n.func) in \
+                        _PROCESS_SALT_FNS:
+                    return True
+            return False
+
+        def flag(call: ast.Call, path_arg: ast.AST, what: str) -> None:
+            self._emit(mod, "GL402", call, qual,
+                       f"{what} under a durable shared root in {qual}(), "
+                       f"reachable from a multihost entry point, with a "
+                       f"filename not salted by jax.process_index() and "
+                       f"not lock-serialized: two hosts sharing the root "
+                       f"clobber each other's artifact (a pid suffix does "
+                       f"not help — pids collide across hosts); fold "
+                       f"process_index into the name, or serialize under "
+                       f"a cross-process lock")
+
+        def check(node: ast.AST, locked: bool) -> None:
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                 ast.Lambda)):
+                return
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                held = locked or any(_is_lockish(it.context_expr)
+                                     for it in node.items)
+                for child in node.body:
+                    check(child, held)
+                return
+            if isinstance(node, ast.Call) and not locked:
+                fn = node.func
+                nm = _terminal_name(fn)
+                if isinstance(fn, ast.Name) and nm == "open" and node.args:
+                    mode = None
+                    if len(node.args) >= 2 and isinstance(
+                            node.args[1], ast.Constant):
+                        mode = node.args[1].value
+                    for kw in node.keywords:
+                        if kw.arg == "mode" and isinstance(
+                                kw.value, ast.Constant):
+                            mode = kw.value.value
+                    if isinstance(mode, str) \
+                            and any(c in mode for c in "wax+") \
+                            and durable(node.args[0]) \
+                            and not is_salted(node.args[0]):
+                        flag(node, node.args[0],
+                             f"direct {mode!r}-mode open()")
+                elif isinstance(fn, ast.Attribute) \
+                        and fn.attr in _NP_WRITE_FNS \
+                        and mod.is_numpy(_attr_root(fn)) and node.args \
+                        and durable(node.args[0]) \
+                        and not is_salted(node.args[0]):
+                    flag(node, node.args[0], f"np.{fn.attr}()")
+                elif nm is not None and "atomic_write" in nm \
+                        and node.args and durable(node.args[0]) \
+                        and not is_salted(node.args[0]):
+                    flag(node, node.args[0], f"{nm}()")
+            for child in ast.iter_child_nodes(node):
+                check(child, locked)
+
+        for stmt in fi.node.body:
+            check(stmt, False)
+
+    def _durable_taint(self, mod: ModuleInfo, body: list) -> dict:
+        """The GL202 durable-root taint over one scope: ``names`` tainted
+        by a durable-root call, ``any`` whether the scope touches a
+        durable root at all (cheap early-out for GL402)."""
+        tainted: set[str] = set()
+        while True:
+            changed = False
+            for node in body:
+                targets: list = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                if value is None or not self._expr_durable(value, tainted):
+                    continue
+                for t in targets:
+                    for nm in _target_names(t):
+                        if nm not in tainted:
+                            tainted.add(nm)
+                            changed = True
+            if not changed:
+                break
+        any_durable = bool(tainted) or any(
+            isinstance(n, ast.Call)
+            and _terminal_name(n.func) in _DURABLE_ROOT_FNS
+            for node in body for n in ast.walk(node))
+        return {"names": tainted, "any": any_durable}
+
+    @staticmethod
+    def _expr_durable(expr: ast.AST, tainted: set[str]) -> bool:
+        for n in ast.walk(expr):
+            if isinstance(n, ast.Call) and \
+                    _terminal_name(n.func) in _DURABLE_ROOT_FNS:
+                return True
+            if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                    and n.id in tainted:
+                return True
+        return False
+
+    def _salted_names(self, mod: ModuleInfo, body: list) -> set[str]:
+        """Names carrying a per-host salt: assigned from an expression
+        containing ``jax.process_index()`` / ``process_tag(...)`` (or an
+        already-salted name), to a fixpoint."""
+        salted: set[str] = set()
+
+        def has_salt(expr: ast.AST) -> bool:
+            for n in ast.walk(expr):
+                if isinstance(n, ast.Call) and _terminal_name(n.func) in \
+                        _PROCESS_SALT_FNS:
+                    return True
+                if isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load) \
+                        and n.id in salted:
+                    return True
+            return False
+
+        while True:
+            changed = False
+            for node in body:
+                targets: list = []
+                value = None
+                if isinstance(node, ast.Assign):
+                    targets, value = node.targets, node.value
+                elif isinstance(node, ast.AnnAssign) and node.value:
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.AugAssign):
+                    targets, value = [node.target], node.value
+                elif isinstance(node, ast.NamedExpr):
+                    targets, value = [node.target], node.value
+                if value is None or not has_salt(value):
+                    continue
+                for t in targets:
+                    for nm in _target_names(t):
+                        if nm not in salted:
+                            salted.add(nm)
+                            changed = True
+            if not changed:
+                break
+        return salted
+
+    def _gl403_function(self, mod: ModuleInfo, fi: FuncInfo) -> None:
+        """Unsharded large operand on a multihost path.  Arm 1: a batched
+        dispatch — ``jit(vmap(f))`` or ``cached_*(tag, vmap(f), args)`` —
+        with no sharding information (``in_shardings``/``mesh=``): the
+        batch-leading operand replicates onto every device instead of
+        sharding the batch axis (ROADMAP item 1's discipline).  Arm 2: a
+        dispatched function closing over a LARGE module-built constant
+        (literal-shape product >= ``_BIG_CONST_ELEMS``) not routed
+        through ``consts=`` — it silently replicates per device and
+        bypasses the registry key."""
+        qual = fi.qualname
+        big = self._large_consts(mod, fi)
+        for node in self._own_body_walk(fi):
+            if not isinstance(node, ast.Call):
+                continue
+            kws = {kw.arg for kw in node.keywords}
+            fn_arg = None
+            if mod.cached_compile_call(node) and len(node.args) >= 2:
+                fn_arg = node.args[1]
+                if isinstance(fn_arg, ast.Call) \
+                        and mod.transform_of(fn_arg.func) == "vmap" \
+                        and "mesh" not in kws:
+                    self._emit(mod, "GL403", node, qual,
+                               f"batched registry compile in {qual}() "
+                               f"(reachable from a multihost entry "
+                               f"point) carries no mesh= — the "
+                               f"batch-leading operand replicates onto "
+                               f"every device; pass the mesh so the "
+                               f"batch axis shards (and the topology "
+                               f"salts the AOT key)")
+            elif mod.transform_of(node.func) == "jit" and node.args:
+                fn_arg = node.args[0]
+                if isinstance(fn_arg, ast.Call) \
+                        and mod.transform_of(fn_arg.func) == "vmap" \
+                        and not (kws & {"in_shardings", "out_shardings"}):
+                    self._emit(mod, "GL403", node, qual,
+                               f"jit(vmap(...)) in {qual}() (reachable "
+                               f"from a multihost entry point) carries "
+                               f"no in_shardings — the batch-leading "
+                               f"operand replicates onto every device "
+                               f"instead of sharding the batch axis")
+            if fn_arg is None or not big:
+                continue
+            consts_decl: set[str] = set()
+            for kw in node.keywords:
+                if kw.arg == "consts":
+                    for n in ast.walk(kw.value):
+                        if isinstance(n, ast.Name):
+                            consts_decl.add(n.id)
+            for captured in self._closure_refs(mod, fi, fn_arg):
+                if captured in big and captured not in consts_decl:
+                    self._emit(mod, "GL403", node, qual,
+                               f"dispatched function closes over large "
+                               f"constant {captured!r} (~{big[captured]} "
+                               f"elements) in {qual}() — it replicates "
+                               f"per device and bypasses the registry "
+                               f"key; pass it through consts= (keyed, "
+                               f"explicitly replicated) or shard it as "
+                               f"an operand")
+
+    def _large_consts(self, mod: ModuleInfo, fi: FuncInfo) -> dict:
+        """Names in ``fi`` bound to a large literal-shaped array
+        constructor (``jnp.zeros((64, 64))``-style): name -> element
+        count."""
+        out: dict = {}
+        for node in self._own_body_walk(fi):
+            if not isinstance(node, ast.Assign):
+                continue
+            v = node.value
+            if not (isinstance(v, ast.Call)
+                    and isinstance(v.func, ast.Attribute)
+                    and v.func.attr in _BIG_ARRAY_CTORS
+                    and (mod.is_numpy(v.func.value)
+                         or mod.is_jnp(v.func.value))):
+                continue
+            elems = 1
+            ints = [n.value for n in ast.walk(v)
+                    if isinstance(n, ast.Constant)
+                    and isinstance(n.value, int)]
+            for i in ints:
+                elems *= max(i, 1)
+            if not ints or elems < _BIG_CONST_ELEMS:
+                continue
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out[t.id] = elems
+        return out
+
+    def _closure_refs(self, mod: ModuleInfo, fi: FuncInfo, fn_arg: ast.AST):
+        """Free names referenced by the function(s) dispatched in
+        ``fn_arg``: nested defs / lambdas resolved in ``fi``'s scope;
+        their own parameters excluded."""
+        seen: set[str] = set()
+        funcs: list[FuncInfo] = []
+        for n in ast.walk(fn_arg):
+            if isinstance(n, ast.Lambda):
+                hit = mod.lambda_infos.get(id(n))
+                if hit is not None:
+                    funcs.append(hit)
+            elif isinstance(n, ast.Name) and isinstance(n.ctx, ast.Load):
+                hit = self.resolve_local(mod, fi, n.id)
+                if hit is not None and hit.parent is fi:
+                    funcs.append(hit)
+        for f in funcs:
+            params = set(f.params)
+            body = ([f.node.body] if isinstance(f.node, ast.Lambda)
+                    else list(f.node.body))
+            for b in body:
+                for n in ast.walk(b):
+                    if isinstance(n, ast.Name) and isinstance(
+                            n.ctx, ast.Load) and n.id not in params \
+                            and n.id not in seen:
+                        seen.add(n.id)
+                        yield n.id
+
+    def _gl404_axes(self, mod: ModuleInfo, declared: set[str]) -> None:
+        """Mesh-axis contract, arm 1: every axis name used in a
+        ``PartitionSpec`` or collective must be declared by SOME mesh in
+        the linted set — a typo'd axis fails at dispatch time, on the
+        pod.  Skipped entirely when no mesh is declared anywhere (a
+        library linted standalone cannot know its caller's axes)."""
+        if not declared:
+            return
+        for scope, node in self._scoped_nodes(mod):
+            if not isinstance(node, ast.Call):
+                continue
+            qual = scope.qualname if scope else "<module>"
+            used: list[tuple[str, ast.AST]] = []
+            if mod.partition_spec_call(node):
+                for a in node.args:
+                    for n in ast.walk(a):
+                        if isinstance(n, ast.Constant) and isinstance(
+                                n.value, str):
+                            used.append((n.value, n))
+            elif mod.collective_call(node):
+                for a in list(node.args[1:]) + [
+                        kw.value for kw in node.keywords
+                        if kw.arg in ("axis_name", "axis_index_groups")]:
+                    for n in ast.walk(a):
+                        if isinstance(n, ast.Constant) and isinstance(
+                                n.value, str):
+                            used.append((n.value, n))
+            for axis, n in used:
+                if axis not in declared:
+                    self._emit(mod, "GL404", node, qual,
+                               f"axis name {axis!r} is not declared by "
+                               f"any Mesh in the linted tree (declared: "
+                               f"{sorted(declared)}) — a typo'd axis "
+                               f"fails at dispatch time, on the pod")
+
+    def _gl404_divergent_collective(self, mod: ModuleInfo,
+                                    fi: FuncInfo) -> None:
+        """Mesh-axis contract, arm 2: a collective lexically inside a
+        branch whose decision is host-divergent — only SOME hosts enter
+        the branch, so the collective's participants never assemble and
+        the program deadlocks.  Checked everywhere (not just multihost
+        paths): the pattern is wrong in any SPMD program."""
+        tainted = self._divergent_names(mod, fi)
+        qual = fi.qualname
+        for node in self._own_body_walk(fi):
+            if isinstance(node, (ast.If, ast.While, ast.IfExp)):
+                decider = node.test
+            else:
+                continue
+            src = self._divergence_source(mod, decider, tainted)
+            if src is None:
+                continue
+            for n in ast.walk(node):
+                if n is decider or any(n is d for d in ast.walk(decider)):
+                    continue
+                if isinstance(n, ast.Call):
+                    coll = mod.collective_call(n)
+                    if coll is not None:
+                        self._emit(mod, "GL404", n, qual,
+                                   f"collective lax.{coll}() inside a "
+                                   f"branch on {src} in {qual}(): hosts "
+                                   f"that skip the branch never join the "
+                                   f"collective — deadlock; run the "
+                                   f"collective unconditionally and mask "
+                                   f"the contribution instead")
 
     def _gl303_env_read(self, mod: ModuleInfo, scope: FuncInfo | None,
                         node: ast.AST, qual: str) -> None:

@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import json
 import os
+import sys
 import tempfile
 import threading
 import time
@@ -107,6 +108,25 @@ def _atomic_write(path: str, text: str) -> None:
             os.unlink(tmp)
 
 
+def process_tag(label: str) -> str:
+    """Per-process export-file tag: ``<label>-p<process_index>-<pid>``.
+
+    The pid alone is NOT collision-safe on a pod — two hosts sharing one
+    export root (a common cache mount) can draw the same pid and clobber
+    each other's files (GL402).  ``jax.process_index()`` is unique per
+    host in a ``jax.distributed`` job and 0 when undistributed; it is
+    read only when jax is already imported — telemetry must never be the
+    reason jax initializes."""
+    idx = 0
+    jax = sys.modules.get("jax")
+    if jax is not None:
+        try:
+            idx = int(jax.process_index())
+        except Exception:
+            idx = 0  # backend not initialized yet: single-process so far
+    return f"{label}-p{idx}-{os.getpid()}"
+
+
 def _jsonl_lines(label: str) -> list:
     lines = [json.dumps({
         "type": "meta", "label": label, "pid": os.getpid(),
@@ -134,7 +154,7 @@ def publish(label: str = "run", directory: str | None = None) -> dict:
             "obs export is not armed: set RAFT_TPU_OBS (1 = cache root, "
             "or a directory) or pass directory=")
     os.makedirs(d, exist_ok=True)
-    tag = f"{label}-{os.getpid()}"
+    tag = process_tag(label)
     paths = {
         "jsonl": os.path.join(d, f"obs-{tag}.jsonl"),
         "chrome_trace": os.path.join(d, f"trace-{tag}.json"),

@@ -29,6 +29,11 @@ from collections import deque
 #: of a busy daemon, small enough that a dump is always instant
 DEFAULT_CAPACITY = 256
 
+#: the dump path writes under the shared obs sink and so falls under the
+#: GL402 shared-root contract even though the serve loop reaches it only
+#: through an instance attribute (invisible to the call-graph edges)
+__graftlint_multihost__ = ("dump",)
+
 
 class FlightRecorder:
     """See module docstring.  Thread contract: ``record`` is called by
@@ -72,16 +77,19 @@ class FlightRecorder:
         pid, reason, exact counters), then one line per record, oldest
         first.  ``path`` overrides the destination; otherwise the file
         lands in the armed ``RAFT_TPU_OBS`` sink directory as
-        ``flight-<label>-<pid>.jsonl`` (None when obs is off — a
-        recorder without a sink has nowhere to durably dump).  Atomic,
-        best-effort: returns the path written or None."""
+        ``flight-<label>-p<process_index>-<pid>.jsonl`` (None when obs
+        is off — a recorder without a sink has nowhere to durably dump;
+        the process-index salt keeps two pod hosts sharing one sink from
+        clobbering each other, GL402).  Atomic, best-effort: returns the
+        path written or None."""
         from raft_tpu.obs import export
 
         if path is None:
             d = export.root()
             if d is None:
                 return None
-            path = os.path.join(d, f"flight-{label}-{os.getpid()}.jsonl")
+            path = os.path.join(
+                d, f"flight-{export.process_tag(label)}.jsonl")
         with self._lock:
             records = [dict(r) for r in self._ring]
             head = {"type": "meta", "label": label, "pid": os.getpid(),

@@ -41,6 +41,19 @@ def init_multihost(coordinator_address: str | None = None,
     coordinator's ``host:port``, the process count, and this process's
     rank.  Must run before the first device operation in the process.
     """
+    # the CPU backend ships with collectives DISABLED ("Multiprocess
+    # computations aren't implemented on the CPU backend"): arm the Gloo
+    # transport before the runtime comes up so a multi-process CPU job
+    # (the SPMD smoke, dev boxes) can actually dispatch cross-process
+    # programs.  TPU/GPU resolve their own interconnect; only arm when
+    # CPU is the explicitly-selected platform, and tolerate builds
+    # without the knob (it only matters where the error would occur).
+    platforms = str(jax.config.jax_platforms or "")
+    if platforms.split(",")[0] == "cpu":
+        try:
+            jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        except Exception:
+            pass
     if coordinator_address is None:
         if num_processes is not None or process_id is not None:
             raise ValueError(
@@ -86,9 +99,17 @@ def stage_global(tree, mesh: Mesh, specs):
     def put(x, spec):
         if x is None:
             return None
-        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+        # host-staging by contract: put() runs OUTSIDE any trace (its whole
+        # job is turning host values into global device arrays before a
+        # dispatch), so the branch inspects a concrete array's ownership,
+        # never a tracer — the idempotence check a re-staged global array
+        # needs.  graftlint marks it jit-reachable only because tree.map
+        # shares a name with lax.map-style transforms.
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:  # graftlint: disable=GL103 — host staging, concrete arrays by contract
             return x        # already a global array — staging is idempotent
-        x = np.asarray(x)
+        # same contract: materializing the host buffer HERE is the point
+        # of staging (each process slices out only its own shards below)
+        x = np.asarray(x)  # graftlint: disable=GL106 — host staging, concrete arrays by contract
         sharding = NamedSharding(mesh, spec)
         return jax.make_array_from_callback(
             x.shape, sharding, lambda idx: x[idx]

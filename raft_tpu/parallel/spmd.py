@@ -1,0 +1,170 @@
+"""Forced virtual-CPU-mesh setup shared by every SPMD consumer.
+
+Three places need "exactly N CPU devices in this process, no hardware":
+the driver's multi-chip dry run (``__graft_entry__.dryrun_multichip``),
+the sharded-lowering audit gate (``raft_tpu.lint.audit``), and the
+two-process SPMD smoke (``raft_tpu.parallel.spmd_smoke``).  Before this
+module they carried private copies of the XLA-flag / config-knob dance,
+which is exactly the kind of setup that drifts silently — one copy
+learns about ``jax_num_cpu_devices`` and the other two keep re-exec'ing.
+This module is the single implementation; ``__graft_entry__`` keeps thin
+delegating aliases for its historical private names.
+
+Mechanism (newest first): the first-class ``jax_num_cpu_devices`` config
+knob (absent on jax <= 0.4.37), falling back to
+``XLA_FLAGS=--xla_force_host_platform_device_count=N`` which a process
+parses at first backend init.  When both fail — older jax in a process
+whose XLA flags were already parsed — :class:`MeshShortfall` tells the
+caller a fresh subprocess with the flag preset would succeed.
+"""
+from __future__ import annotations
+
+import os
+import re
+import sys
+
+
+class MeshShortfall(RuntimeError):
+    """Raised when the virtual CPU mesh cannot reach the requested device
+    count in THIS process but a re-exec with XLA_FLAGS preset would."""
+
+
+def with_host_device_flag(flags: str, n_devices: int) -> str:
+    """XLA_FLAGS with ``--xla_force_host_platform_device_count=N`` set to
+    EXACTLY ``n_devices`` — replacing any existing (possibly smaller)
+    value rather than keeping it, so a process that inherited count=8 can
+    still stage a 16-device dry run."""
+    pat = r"--xla_force_host_platform_device_count=\d+"
+    new = f"--xla_force_host_platform_device_count={n_devices}"
+    if re.search(pat, flags):
+        return re.sub(pat, new, flags)
+    return (flags + " " + new).strip()
+
+
+def config_cpu_devices(jax, n_devices: int) -> bool:
+    """Set the first-class ``jax_num_cpu_devices`` knob when this jax has
+    it.  Returns False on older jax (e.g. 0.4.37 raises AttributeError:
+    "Unrecognized config option") — the XLA_FLAGS fallback then has to
+    carry the device count on its own."""
+    try:
+        jax.config.update("jax_num_cpu_devices", n_devices)
+        return True
+    except (AttributeError, KeyError, ValueError, RuntimeError):
+        # AttributeError: jax <= 0.4.37 has no such option; RuntimeError:
+        # newer jax refuses the knob after backend init — either way the
+        # XLA_FLAGS / re-exec fallback must carry the device count
+        return False
+
+
+def cpu_device_plan(knob_ok: bool, n_visible: int, n_needed: int,
+                    reexec_blocked: bool) -> str:
+    """Decide how to proceed after backend init: ``"ok"`` (mesh big
+    enough), ``"reexec"`` (older jax whose XLA_FLAGS were parsed before
+    our flag landed — a fresh subprocess with the flag preset will see the
+    full mesh), or ``"fail"`` (nothing left to try: the knob took effect
+    or a re-exec already happened, yet devices are still short)."""
+    if n_visible >= n_needed:
+        return "ok"
+    if knob_ok or reexec_blocked:
+        return "fail"
+    return "reexec"
+
+
+def _backend_initialized() -> bool:
+    """True when this process has already created a jax backend (and so
+    already spent its one XLA_FLAGS parse).  Probes the registry dict
+    directly — calling ``jax.devices()`` to find out would itself
+    initialize the backend."""
+    try:
+        from jax._src import xla_bridge as _xb
+
+        return bool(getattr(_xb, "_backends", None))
+    except Exception:
+        return False
+
+
+def force_cpu_devices(n_devices: int, *, cache_dir: str | None = None):
+    """Return the jax module with >= ``n_devices`` virtual CPU devices.
+
+    Forces CPU *unconditionally* — SPMD dry runs, audits, and smokes are
+    correctness checks of the sharded programs on a virtual mesh; they
+    never need (and must never touch) real accelerator hardware.  Only
+    ``jax.config.update('jax_platforms', 'cpu')`` reliably overrides a
+    ``sitecustomize``-pinned backend, and it must land before backend
+    init; ``clear_backends()`` first makes the sequence safe even if some
+    earlier code in this process already created a backend.
+
+    ``cache_dir``, when given, arms the persistent compilation cache
+    there (SPMD checks are ~95% XLA compile time; a warm on-disk cache
+    turns a budget-marginal run into a fast one).
+
+    Raises :class:`MeshShortfall` when this process cannot reach the
+    count but a re-exec with the flag preset would; raises AssertionError
+    when nothing is left to try.
+    """
+    jax_live = sys.modules.get("jax")
+    if jax_live is not None and _backend_initialized():
+        # jax.devices() on an UNinitialized backend would itself trigger
+        # backend init — and burn the one XLA_FLAGS parse this function
+        # is about to stage — so only probe a backend that already exists
+        try:
+            devs = jax_live.devices()
+            if devs and devs[0].platform == "cpu" and len(devs) >= n_devices:
+                # already satisfied (e.g. the test session's 8 virtual
+                # devices): resetting live backends here would invalidate
+                # every array the process has staged — don't
+                return jax_live
+        except Exception:
+            pass
+    # parsed at first backend init; the config knob below covers re-init.
+    # Always normalized to n_devices — an inherited smaller count must be
+    # replaced, not kept.
+    os.environ["XLA_FLAGS"] = with_host_device_flag(
+        os.environ.get("XLA_FLAGS", ""), n_devices)
+    import jax
+    from jax.extend.backend import clear_backends
+
+    clear_backends()  # no-op in a fresh process; resets any earlier backend
+    jax.config.update("jax_platforms", "cpu")
+    knob_ok = config_cpu_devices(jax, n_devices)
+    if cache_dir is not None:
+        try:
+            jax.config.update("jax_compilation_cache_dir", cache_dir)
+            jax.config.update(
+                "jax_persistent_cache_min_compile_time_secs", 0.5)
+        except Exception:
+            pass  # older jax without the knobs: compile cold, still correct
+    devices = jax.devices()
+    assert devices[0].platform == "cpu", (
+        f"forced jax_platforms=cpu but backend is {devices[0].platform}"
+    )
+    plan = cpu_device_plan(
+        knob_ok, len(devices), n_devices,
+        reexec_blocked=bool(os.environ.get("RAFT_TPU_DRYRUN_NO_REEXEC")),
+    )
+    if plan == "reexec":
+        raise MeshShortfall(
+            f"need {n_devices} cpu devices, have {len(devices)}; this jax "
+            f"lacks jax_num_cpu_devices and XLA_FLAGS were already parsed "
+            f"— re-exec with the flag preset"
+        )
+    assert plan == "ok", (
+        f"need {n_devices} cpu devices, have {len(devices)} "
+        f"(knob_ok={knob_ok}, XLA_FLAGS={os.environ.get('XLA_FLAGS')!r})"
+    )
+    return jax
+
+
+def forced_cpu_mesh(n_devices: int, axis: str = "batch", *,
+                    cache_dir: str | None = None):
+    """``(jax, Mesh)``: force ``n_devices`` virtual CPU devices and build
+    the 1-D mesh every SPMD consumer shards over.  The single construction
+    point for audit / smoke meshes, so the axis name and device ordering
+    cannot drift between them."""
+    import numpy as np
+
+    jax = force_cpu_devices(n_devices, cache_dir=cache_dir)
+    from jax.sharding import Mesh
+
+    mesh = Mesh(np.array(jax.devices()[:n_devices]), axis_names=(axis,))
+    return jax, mesh

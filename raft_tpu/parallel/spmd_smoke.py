@@ -1,0 +1,217 @@
+"""Deterministic two-process SPMD smoke: ``make spmd-smoke``.
+
+``tests/test_multihost.py`` proves the frequency-sharded RAO solve
+crosses a process boundary; this smoke pins the remaining multi-host
+claims the GL4xx rules and the sharded-lowering audit reason about,
+end to end and in well under 90 s of CPU:
+
+* **sharded == unsharded** — two coordinated processes (2 x 4 virtual
+  CPU devices, one global 8-device ``designs`` mesh) run
+  :func:`raft_tpu.parallel.sweep.sweep_designs` with ``mesh=`` — the
+  design axis sharded over the pod mesh, each process materializing
+  only its own lanes — and rank 0 prints the gathered response; the
+  parent recomputes the same batch UNSHARDED on a single process and
+  requires agreement to float-eps (the "sharding is a layout decision,
+  never a numerics decision" contract);
+* **one shared cache root, zero collisions** — both workers AND the
+  parent's oracle run against one ``RAFT_TPU_CACHE_DIR``: the AOT
+  registry, the staging cache, and the obs export sinks all take
+  concurrent two-process traffic.  Afterwards the parent asserts every
+  observability artifact carries a distinct per-process name
+  (``-p<process_index>-<pid>`` — the GL402 salt) and that no torn
+  ``*.tmp`` files survive anywhere under the root (the GL202 atomic
+  publish contract, now cross-process).
+
+Run modes: no arguments = parent (spawns the two workers, runs the
+oracle, checks everything); ``--worker <rank> <port>`` = one SPMD
+worker (internal).  Exit code 0 on success.
+"""
+from __future__ import annotations
+
+import glob
+import os
+import socket
+import subprocess
+import sys
+import time
+
+#: worker topology: 2 processes x LOCAL_DEVICES virtual CPU devices form
+#: the global mesh the sharded-lowering audit also assumes (8 devices)
+N_PROCESSES = 2
+LOCAL_DEVICES = 4
+
+#: the staged batch: 8 lanes of the stock OC3 spar — one lane per global
+#: device, one shape bucket, lane count divisible by the mesh
+N_DESIGNS = 8
+NW = 6
+N_ITER = 4
+
+#: sharded-vs-unsharded agreement bound, relative to the result scale.
+#: The lanes run the SAME per-lane program either way (vmap lanes are
+#: independent; sharding only places them), so only compilation-level
+#: reassociation can differ — float eps territory, not algorithm drift.
+PARITY_RTOL = 1e-9
+
+
+def _design_paths() -> list:
+    import raft_tpu
+
+    pkg = os.path.dirname(os.path.abspath(raft_tpu.__file__))
+    return [os.path.join(pkg, "designs", "OC3spar.yaml")] * N_DESIGNS
+
+
+def _solve(mesh=None) -> "object":
+    """The exact batch both sides solve: std-dev response of N_DESIGNS
+    OC3 lanes (x64, like the multihost test oracle, so parity is pinned
+    at 1e-9 instead of f32 noise)."""
+    from raft_tpu.parallel.sweep import sweep_designs
+
+    out = sweep_designs(_design_paths(), nw=NW, n_iter=N_ITER,
+                        return_xi=False, mesh=mesh)
+    return out["std dev"]
+
+
+def worker(rank: int, port: str) -> int:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_enable_x64", True)
+
+    from raft_tpu.parallel.multihost import global_mesh, init_multihost
+
+    init_multihost(f"localhost:{port}", num_processes=N_PROCESSES,
+                   process_id=rank)
+    assert jax.process_count() == N_PROCESSES, jax.process_count()
+    assert jax.device_count() == N_PROCESSES * LOCAL_DEVICES, (
+        jax.device_count())
+
+    import numpy as np
+
+    std = np.asarray(_solve(mesh=global_mesh(("designs",))))
+    # both ranks hold the full gathered result (process_allgather in the
+    # mesh path); rank 0 speaks for the job
+    if rank == 0:
+        print("STD", " ".join(f"{v:.17e}" for v in std.ravel()),
+              flush=True)
+        print("SHAPE", " ".join(str(s) for s in std.shape), flush=True)
+    print(f"WORKER_OK {rank}", flush=True)
+    return 0
+
+
+def _check_exports(obs_dir: str) -> list:
+    """Every export artifact must be per-process-salted and whole."""
+    problems = []
+    jsonl = sorted(glob.glob(os.path.join(obs_dir,
+                                          "obs-sweep_designs-*.jsonl")))
+    tags = {os.path.basename(p).split("-p", 1)[1].split("-", 1)[0]
+            for p in jsonl}
+    if len(jsonl) != N_PROCESSES:
+        problems.append(f"expected {N_PROCESSES} per-process obs logs, "
+                        f"found {len(jsonl)}: {jsonl}")
+    if tags != {str(i) for i in range(N_PROCESSES)}:
+        problems.append(f"expected process-index salts 0..{N_PROCESSES - 1}"
+                        f" in export names, found {sorted(tags)}")
+    return problems
+
+
+def _check_no_torn_files(root: str) -> list:
+    tmps = glob.glob(os.path.join(root, "**", "*.tmp"), recursive=True)
+    return [f"torn tmp artifacts under the shared root: {tmps}"] if tmps \
+        else []
+
+
+def main() -> int:
+    if len(sys.argv) >= 2 and sys.argv[1] == "--worker":
+        return worker(int(sys.argv[2]), sys.argv[3])
+
+    import tempfile
+
+    import numpy as np
+
+    t0 = time.perf_counter()
+    repo = os.getcwd()
+    with tempfile.TemporaryDirectory(prefix="spmd_smoke_") as cache:
+        obs_dir = os.path.join(cache, "obs")
+        with socket.socket() as s:
+            s.bind(("localhost", 0))
+            port = s.getsockname()[1]
+        env = {
+            **os.environ,
+            "XLA_FLAGS": f"--xla_force_host_platform_device_count="
+                         f"{LOCAL_DEVICES}",
+            "JAX_PLATFORMS": "cpu",
+            "RAFT_TPU_CACHE_DIR": cache,       # ONE root, two writers
+            "RAFT_TPU_OBS": obs_dir,
+            "PYTHONPATH": repo + os.pathsep + os.environ.get(
+                "PYTHONPATH", ""),
+        }
+        procs = [
+            subprocess.Popen(  # graftlint: disable=GL203 — two coordinated workers must run CONCURRENTLY (checked_subprocess is sequential); the communicate(timeout=300) + kill below is the hard timeout
+                [sys.executable, "-m", "raft_tpu.parallel.spmd_smoke",
+                 "--worker", str(rank), str(port)],
+                cwd=repo, env=env, stdout=subprocess.PIPE,
+                stderr=subprocess.STDOUT, text=True)
+            for rank in range(N_PROCESSES)
+        ]
+        outs = []
+        try:
+            for p in procs:
+                out, _ = p.communicate(timeout=300)
+                outs.append(out)
+        finally:
+            for p in procs:
+                if p.poll() is None:
+                    p.kill()
+                    p.communicate()
+        for p, out in zip(procs, outs):
+            if p.returncode != 0:
+                print("[spmd-smoke] FAIL: worker died\n"
+                      + "\n---\n".join(o[-3000:] for o in outs))
+                return 1
+        std_line = next(ln for ln in outs[0].splitlines()
+                        if ln.startswith("STD "))
+        shape = tuple(int(s) for s in next(
+            ln for ln in outs[0].splitlines()
+            if ln.startswith("SHAPE ")).split()[1:])
+        std_sharded = np.array(
+            [float(v) for v in std_line.split()[1:]]).reshape(shape)
+
+        # unsharded oracle IN THIS PROCESS, same shared cache root (the
+        # worker-compiled sharded executables and this one must coexist
+        # under one AOT registry), obs deliberately unarmed so the
+        # export-collision census below counts exactly the two workers
+        os.environ["RAFT_TPU_CACHE_DIR"] = cache
+        os.environ.pop("RAFT_TPU_OBS", None)
+        import jax
+
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_enable_x64", True)
+        std_ref = np.asarray(_solve(mesh=None))
+
+        problems = []
+        scale = float(np.abs(std_ref).max())
+        err = float(np.abs(std_sharded - std_ref).max())
+        if not (err <= PARITY_RTOL * scale):
+            problems.append(
+                f"sharded != unsharded: max err {err:.3e} vs bound "
+                f"{PARITY_RTOL * scale:.3e}")
+        problems += _check_exports(obs_dir)
+        problems += _check_no_torn_files(cache)
+
+        dt = time.perf_counter() - t0
+        if problems:
+            print("[spmd-smoke] FAIL:")
+            for pr in problems:
+                print(f"[spmd-smoke]   {pr}")
+            return 1
+        print(f"[spmd-smoke] ok — {N_PROCESSES} processes x "
+              f"{LOCAL_DEVICES} devices, {N_DESIGNS} lanes sharded over "
+              f"the global mesh; parity err {err:.3e} "
+              f"(bound {PARITY_RTOL * scale:.3e}); "
+              f"{N_PROCESSES} salted export logs, no torn files; "
+              f"{dt:.1f}s")
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -863,7 +863,7 @@ def _sweep_sea_states_chunked(members, rna, env, waves, C_moor, bem,
     staged0 = stage(0)
     extra = ("n_iter", n_iter, "F_ax", F_ax, "chunk", chunk,
              "health", bool(health))
-    fn = _cache.cached_callable(
+    fn = _cache.cached_callable(  # graftlint: disable=GL403 — chunked pipeline splits the case axis on the HOST (single-host by construction); sweep_designs(mesh=) is the sharded path
         "sweep_sea_states", jax.vmap(one, in_axes=(0, F_ax, F_ax)),
         staged0,
         consts=(members, rna, env, C_moor, staged or ()),
@@ -1172,18 +1172,106 @@ def _record_bucket_metrics(_obs, batch, B, dispatch_s) -> None:
     _obs.metrics.counter("sweep_designs.lanes").inc(B)
 
 
+def _stage_bucket_global(args, in_axes, mesh):
+    """Host-staged bucket args -> globally-sharded jax.Arrays with the
+    design (batch-leading) axis split over the mesh's first axis.
+
+    The GL403 contract: a pod-scale design batch must enter the compiled
+    call SHARDED, not host-replicated onto every device — each process
+    materializes only its own lanes (:func:`stage_global`), and jit
+    infers the executable's input shardings from the committed arrays."""
+    from jax.sharding import PartitionSpec as P
+    from raft_tpu.parallel import multihost as _mh
+
+    axis = mesh.axis_names[0]
+    n = int(mesh.devices.shape[0])
+    B = len(args[0].seg_l)
+    if B % n != 0:
+        raise ValueError(
+            f"sweep_designs: bucket lane count {B} is not divisible by "
+            f"mesh axis {axis!r} size {n} — pad the design batch or use "
+            "a divisor-sized mesh")
+    return tuple(
+        _mh.stage_global(
+            a, mesh,
+            jax.tree_util.tree_map(
+                lambda _, _ax=ax: P(axis) if _ax == 0 else P(), a))
+        for a, ax in zip(args, in_axes))
+
+
+def _gather_bucket_outputs(outs, mesh):
+    """Sharded bucket outputs -> host arrays every process fully holds.
+
+    Single-process meshes: the global arrays are already fully
+    addressable, pass through.  Multi-process meshes: each host owns only
+    its lanes' shards, so the result-scatter (original design order)
+    needs an explicit cross-host gather."""
+    from raft_tpu.parallel import multihost as _mh
+
+    if not _mh.is_multiprocess(mesh):
+        return outs
+    from jax.experimental import multihost_utils
+
+    return tuple(multihost_utils.process_allgather(o, tiled=True)
+                 for o in outs)
+
+
+def _dispatch_sharded_bucket(one, args, in_axes, mesh, extra):
+    """One bucket's batch dispatch with the design axis sharded over
+    ``mesh``'s first axis: ``shard_map`` hands each device its own lane
+    block and a local ``vmap`` solves it — pure data parallelism, zero
+    collectives (the lanes are independent; only the host-side gather
+    crosses shards).  ``shard_map`` rather than bare GSPMD because the
+    CPU backend refuses multi-process jit-partitioned computations (the
+    freq-sharded precedent), and a shard_mapped program runs identically
+    on single- and multi-process meshes.
+
+    Single-process meshes go through the AOT registry (``mesh`` folds
+    the topology into the key); multi-process meshes dispatch eagerly —
+    a multi-host executable is not portably storable."""
+    from raft_tpu import cache as _cache
+
+    shard_map, kw = _shard_map()
+    axis = mesh.axis_names[0]
+    g_args = _stage_bucket_global(args, in_axes, mesh)
+    in_specs = tuple(P(axis) if ax == 0 else P() for ax in in_axes)
+
+    def run(*local_args):
+        return jax.vmap(one, in_axes=in_axes)(*local_args)
+
+    sharded = shard_map(run, mesh=mesh, in_specs=in_specs,
+                        out_specs=P(axis), **kw)
+    if is_multiprocess(mesh):
+        outs = jax.block_until_ready(sharded(*g_args))
+        return _gather_bucket_outputs(outs, mesh)
+    fn = _cache.cached_callable("sweep_designs", sharded, g_args,
+                                extra=(*extra, "sharded"), mesh=mesh)
+    return jax.block_until_ready(fn(*g_args))
+
+
 def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
-                          chunk, pipeline_depth):
+                          chunk, pipeline_depth, mesh=None):
     """Solve ONE shape bucket's stacked design batch as one padded device
     dispatch: the per-design arrays (members, RNA, env, wave, mooring,
     optional BEM) are batch-leading vmapped INPUTS — not closure
     constants like :func:`sweep` — so the compiled executable is
     design-agnostic: any mix of designs in this bucket class (and batch
-    size) reuses it, in-process and through the AOT registry."""
+    size) reuses it, in-process and through the AOT registry.
+
+    ``mesh``: optional 1-D device mesh — the design axis is sharded over
+    its first axis (multi-host meshes included; lane salvage and the
+    result scatter stay host-side, so ``health`` composes).  The chunked
+    pipeline path is mutually exclusive with ``mesh``: chunking splits
+    the lane axis on the HOST, sharding splits it on the mesh."""
     from raft_tpu import cache as _cache
     from raft_tpu import obs as _obs
     from raft_tpu.build import buckets as _buckets
 
+    if mesh is not None and chunk is not None:
+        raise ValueError(
+            "sweep_designs: mesh= and chunk= both split the design axis "
+            "(mesh over devices, chunk over pipelined host dispatches) — "
+            "pass one or the other")
     B = len(batch.fnames)
     has_bem = batch.bem is not None
     dtype = batch.members.seg_l.dtype
@@ -1230,7 +1318,7 @@ def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
             return (*lanes, b)
 
         staged0 = stage(0)
-        fn = _cache.cached_callable(
+        fn = _cache.cached_callable(  # graftlint: disable=GL403 — chunked pipeline splits the lane axis on the HOST (single-host by construction); sweep_designs(mesh=) is the sharded path
             "sweep_designs", jax.vmap(one, in_axes=in_axes), staged0,
             extra=(*extra, "chunk", chunk))
         # durable chunk store (RAFT_TPU_CKPT): the executable's key hashes
@@ -1262,10 +1350,22 @@ def _sweep_designs_bucket(batch, n_iter, return_xi, health, escalate,
             dispatch_s = time.perf_counter() - t0
         outs = tuple(np.concatenate([np.atleast_1d(r[j]) for r in results])
                      for j in range(len(results[0])))
+    elif mesh is not None:
+        with _obs.trace.span("sweep_designs/bucket",
+                             attrs={"sig": _sig_label(batch.sig),
+                                    "lanes": B, "sharded": True}):
+            t0 = time.perf_counter()
+            outs = _dispatch_sharded_bucket(one, args, in_axes, mesh,
+                                            extra)
+            dispatch_s = time.perf_counter() - t0
+        # the ledger is skipped here: on a multi-process mesh there is
+        # no storable executable to attribute the dispatch to, and a
+        # per-host wall time over a pod dispatch would not be comparable
+        # to the single-host rows anyway
     else:
         fn = _cache.cached_callable(
             "sweep_designs", jax.vmap(one, in_axes=in_axes), args,
-            extra=extra)
+            extra=extra, mesh=mesh)
         # the span times dispatch THROUGH materialization (the compiled
         # call returns futures; the results are fetched right below
         # anyway, so the barrier moves no work — it only makes the
@@ -1354,6 +1454,7 @@ def sweep_designs(
     escalate: bool = True,
     chunk: int | None = None,
     pipeline_depth: int | None = None,
+    mesh=None,
 ):
     """Solve a MIXED batch of different platform designs — one padded
     device dispatch per shape bucket.
@@ -1380,7 +1481,13 @@ def sweep_designs(
     dispatch-ahead pipeline (:mod:`raft_tpu.parallel.pipeline`).
     ``health=True``: the resilience contract per lane — a bad design's
     lane is quarantined and ladder-salvaged without touching its
-    bucket-mates (see :func:`sweep_sea_states`).
+    bucket-mates (see :func:`sweep_sea_states`).  ``mesh``: optional 1-D
+    device mesh (:func:`make_mesh` /
+    :func:`raft_tpu.parallel.multihost.global_mesh`) — each bucket's
+    design axis is sharded over the mesh's first axis, with the inputs
+    staged globally (:func:`stage_global`) so a multi-host job
+    materializes only its own lanes; every bucket's lane count must
+    divide the mesh size.  Mutually exclusive with ``chunk``.
 
     Returns a dict in the ORIGINAL design order: ``"std dev"`` (D, 6),
     ``"iterations"`` (D,), ``"Xi_abs2"`` (D, nw, 6) trimmed to the
@@ -1411,7 +1518,7 @@ def sweep_designs(
 
     per_bucket = [
         _sweep_designs_bucket(b, n_iter, return_xi, health, escalate,
-                              chunk, pipeline_depth)
+                              chunk, pipeline_depth, mesh=mesh)
         for b in batches
     ]
 

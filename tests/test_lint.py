@@ -303,6 +303,155 @@ FIXTURES = {
             return int(os.environ.get("RAFT_TPU_PIPELINE_DEPTH", "2"))
         """,
     ),
+    "GL401": (
+        """
+        import os
+
+        import jax
+        from raft_tpu.cache import cached_callable
+
+        __graftlint_multihost__ = ("sweep",)
+
+        def sweep(xs, mesh):
+            if os.environ.get("SWEEP_DEBUG_HOST"):
+                return _dispatch(xs, mesh)
+            return xs
+
+        def _dispatch(xs, mesh):
+            fn = cached_callable("t", jax.vmap(lambda x: x * 2), (xs,),
+                                 mesh=mesh)
+            return fn(xs)
+        """,
+        """
+        import os
+
+        import jax
+        from raft_tpu.cache import cached_callable
+
+        __graftlint_multihost__ = ("sweep",)
+
+        def sweep(xs, mesh):
+            # key-salted knob: the compiled program moves WITH the value,
+            # identically on every host (the GL303 triage precedent)
+            if os.environ.get("RAFT_TPU_BEM"):
+                return _dispatch(xs, mesh)
+            return xs
+
+        def _dispatch(xs, mesh):
+            fn = cached_callable("t", jax.vmap(lambda x: x * 2), (xs,),
+                                 mesh=mesh)
+            return fn(xs)
+        """,
+    ),
+    "GL402": (
+        """
+        import os
+
+        __graftlint_multihost__ = ("export",)
+
+        def resolve_dir():
+            return os.environ.get("RAFT_TPU_OBS", "/tmp/obs")
+
+        def _atomic_write(path, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+
+        def export(payload):
+            d = resolve_dir()
+            path = os.path.join(d, f"obs-{os.getpid()}.jsonl")
+            _atomic_write(path, payload)
+        """,
+        """
+        import os
+
+        import jax
+
+        __graftlint_multihost__ = ("export",)
+
+        def resolve_dir():
+            return os.environ.get("RAFT_TPU_OBS", "/tmp/obs")
+
+        def _atomic_write(path, payload):
+            tmp = path + ".tmp"
+            with open(tmp, "w") as f:
+                f.write(payload)
+            os.replace(tmp, path)
+
+        def export(payload):
+            d = resolve_dir()
+            tag = f"p{jax.process_index()}-{os.getpid()}"
+            path = os.path.join(d, f"obs-{tag}.jsonl")
+            _atomic_write(path, payload)
+        """,
+    ),
+    "GL403": (
+        """
+        import jax
+        import jax.numpy as jnp
+        from raft_tpu.cache import cached_callable
+
+        __graftlint_multihost__ = ("sweep",)
+
+        def sweep(xs):
+            big = jnp.zeros((64, 64))
+
+            def one(x):
+                return (x * big).sum()
+
+            fn = cached_callable("t", jax.vmap(one), (xs,))
+            return fn(xs)
+        """,
+        """
+        import jax
+        import jax.numpy as jnp
+        from raft_tpu.cache import cached_callable
+
+        __graftlint_multihost__ = ("sweep",)
+
+        def sweep(xs, mesh):
+            big = jnp.zeros((64, 64))
+
+            def one(x):
+                return (x * big).sum()
+
+            fn = cached_callable("t", jax.vmap(one), (xs,),
+                                 consts=(big,), mesh=mesh)
+            return fn(xs)
+        """,
+    ),
+    "GL404": (
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        __graftlint_multihost__ = ("reduce_stats",)
+
+        def make_mesh():
+            return Mesh(np.array(jax.devices()), axis_names=("designs",))
+
+        def reduce_stats(x):
+            if jax.process_index() == 0:
+                x = jax.lax.psum(x, "dezigns")
+            return x
+        """,
+        """
+        import jax
+        import numpy as np
+        from jax.sharding import Mesh
+
+        __graftlint_multihost__ = ("reduce_stats",)
+
+        def make_mesh():
+            return Mesh(np.array(jax.devices()), axis_names=("designs",))
+
+        def reduce_stats(x):
+            # unconditional: every host joins, on the declared axis
+            return jax.lax.psum(x, "designs")
+        """,
+    ),
 }
 
 
@@ -744,6 +893,58 @@ def test_concurrent_entry_registry_drift():
             f"'Concurrency contracts'")
 
 
+def test_multihost_entry_registry_drift():
+    """Every multihost=True audit entry is also sharded=True (a pod
+    entry whose lowering is never audited sharded is a blind spot),
+    every MULTIHOST_FUNCTIONS name resolves to a real callable (no
+    zombie flags), and each is named in the docs' SPMD contracts
+    section — the concurrent-registry precedent, one family up."""
+    import importlib
+
+    from raft_tpu.lint import registry
+
+    mh = {e.name for e in registry.ENTRY_POINTS if e.multihost}
+    sharded = {e.name for e in registry.ENTRY_POINTS if e.sharded}
+    assert mh, "no multihost=True entries registered"
+    assert mh <= sharded, (
+        f"multihost entries missing the sharded-lowering audit: "
+        f"{sorted(mh - sharded)}")
+    for dotted in registry.MULTIHOST_FUNCTIONS:
+        mod_name, fn_name = dotted.rsplit(".", 1)
+        fn = getattr(importlib.import_module(mod_name), fn_name, None)
+        assert callable(fn), f"zombie multihost flag: {dotted}"
+    docs = open(os.path.join(REPO, "docs", "architecture.rst"),
+                encoding="utf-8").read()
+    assert "SPMD contracts" in docs
+    for dotted in registry.MULTIHOST_FUNCTIONS:
+        assert dotted in docs, (
+            f"{dotted} missing from docs/architecture.rst "
+            f"'SPMD contracts'")
+
+
+def test_sharded_lowering_bound_on_real_entry():
+    """The sharded-lowering gate, end to end on one real registry entry:
+    lowering sweep_designs with the batch axis sharded over the audit
+    mesh must cost <= replicated / n_devices x (1 + tolerance) in
+    per-device peak bytes — the claim budgets.json commits for every
+    sharded entry.  Missing metrics must fail LOUD."""
+    from raft_tpu.lint import audit, registry
+
+    e = next(e for e in registry.ENTRY_POINTS
+             if e.name == "sweep_designs" and e.sharded)
+    mesh = audit._sharded_mesh()
+    m = audit.sharded_metrics(e, mesh)
+    n = audit.SHARDED_MESH_DEVICES
+    assert m["sharded_mesh_devices"] == n
+    assert m["sharded_batch_lanes"] % n == 0
+    ok, notes = audit.check_sharded(e.name, m)
+    assert ok, notes
+    assert m["sharded_peak_bytes"] <= (
+        m["replicated_peak_bytes"] / n * (1 + audit.SHARDED_TOLERANCE))
+    bad_ok, bad_notes = audit.check_sharded("ghost", {})
+    assert not bad_ok and bad_notes
+
+
 def test_gl3xx_baseline_reasons_cover_triaged_findings():
     """Every triaged GL3xx fingerprint carries its justification in the
     baseline's _reasons map — the zero-unsuppressed-findings bar means
@@ -1045,6 +1246,12 @@ def test_budget_audit_integration_vs_committed():
     (entry,) = get_entries(["dlc_solve"])
     r = audit_entry(entry, retrace_check=False, collect_metrics=True)
     assert r.metrics and r.metrics["flops"] > 0
+    # sharded entries commit sharded-lowering metrics too; the gate
+    # fails LOUD on committed-but-unmeasured keys, so measure them the
+    # way run_audit does before checking
+    from raft_tpu.lint.audit import _sharded_mesh, sharded_metrics
+
+    r.metrics.update(sharded_metrics(entry, _sharded_mesh()))
     budgets = _committed_budgets()
     ok, notes = check_budget("dlc_solve", r.metrics, budgets, "cpu")
     assert ok, notes

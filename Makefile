@@ -2,7 +2,7 @@
 # mesh via tests/conftest.py); bench probes the pinned device and falls
 # back to a labeled CPU measurement when it is unreachable.
 
-.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke spmd-smoke serve-smoke bem-smoke lint lint-budgets
+.PHONY: fast test evidence bench dryrun cache-smoke pipeline-smoke resilience-smoke hetero-smoke obs-smoke race-smoke spmd-smoke serve-smoke fleet-smoke bem-smoke lint lint-budgets
 
 fast:            ## fast test tier (< 8 min on one core)
 	python -m pytest tests/ -q -m "not slow"
@@ -36,6 +36,9 @@ spmd-smoke:      ## deterministic 2-process SPMD proof: design axis sharded over
 
 serve-smoke:     ## resident-daemon proof: compiles == buckets, solo parity, warm
 	python -m raft_tpu.serve smoke   # restart 0 compiles; armed obs leg: request traces/SLO/flight/ledger
+
+fleet-smoke:     ## fault-tolerant fleet proof: 2 replicas, kill_replica:1 mid-stream,
+	python -m raft_tpu.serve fleet-smoke   # zero lost/dup + bit-identical rows, warm zero-compile restart, deterministic typed shed + recover
 
 bem-smoke:       ## on-device BEM proof: novel geometry solves with g++ POISONED
 	python -m raft_tpu.hydro.bem_smoke   # (no host solver), oracle parity, warm/novel zero compiles; pallas-interpret leg: cross-route parity, zero compiles warm

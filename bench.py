@@ -997,6 +997,229 @@ def serving_block(n_requests: int = 48, rate: float = 400.0,
     }
 
 
+#: the fleet bench stream: FOUR labels so bucket-affinity routing splits
+#: evenly over 2 and 4 replicas (with 3 labels the ideal 2-replica split
+#: is 2:1 and the scaling ceiling 1.5x — a routing artifact, not a
+#: serving one)
+FLEET_DESIGNS = ("oc3", "oc4", "oc4_2", "volturnus")
+
+
+def serving_fleet_block(n_requests: int = 36, rate: float = 400.0,
+                        replica_counts=(1, 2, 4), n_step: int = 24,
+                        nw: int = 64, n_iter: int = 25, batch_max: int = 4,
+                        deadline_ms: float = 40.0):
+    """The ``serving_fleet`` bench block: replica scaling through the
+    fault-tolerant fleet (:mod:`raft_tpu.serve.fleet`) — REAL daemon
+    children (one process per replica, CPU-pinned: a device fleet needs
+    one chip per replica) behind the in-process failover router.
+
+    Legs, all on ONE shared AOT cache root (only the first fleet pays
+    compiles; every later replica arms warm):
+
+    * **scaling**: the same open-loop 4-design stream at 1, 2, and 4
+      replicas; ``solves/s`` per count and the 2x/4x ratios (the
+      ``>= 1.7x at 2 replicas`` acceptance gate — four labels split 2:2
+      under bucket-affinity routing, so near-linear is achievable).
+      Each child is pinned to ONE intra-op XLA thread so a replica
+      models one device, not the whole host (unpinned, a single XLA CPU
+      process saturates every core and replica scaling is flat by
+      construction).  On a host with fewer than 2 cores the ratios are
+      still reported but the gate is ``null`` — N processes multiplexing
+      one core cannot scale, and pretending otherwise would be a
+      measurement of the scheduler, not the fleet;
+    * **load step** (at 2 replicas): p99 at half the measured capacity
+      vs at 3x capacity — the queueing-delay cliff, measured;
+    * **kill leg** (at 2 replicas): the counted ``kill_replica:1`` fault
+      SIGKILLs a replica on the first dispatch of a measured pass; every
+      request still answers exactly once (failover resubmission) and the
+      leg's p99 prices the disruption against the steady-state p99.
+    """
+    import shutil
+    import tempfile
+
+    from raft_tpu.resilience import faults
+    from raft_tpu.serve import loadgen
+    from raft_tpu.serve.client import SolveClient
+    from raft_tpu.serve.fleet import Fleet, FleetConfig
+    from raft_tpu.serve.fleet_smoke import (_fleet_env,
+                                            _replica_solver_stats)
+
+    tmp = tempfile.mkdtemp(prefix="raft_bench_fleet_")
+    cache_dir = os.path.join(tmp, "cache")
+    serve_args = ["--nw", str(nw), "--n-iter", str(n_iter),
+                  "--batch-max", str(batch_max),
+                  "--deadline-ms", str(deadline_ms),
+                  "--warm", ",".join(FLEET_DESIGNS)]
+    env = _fleet_env(cache_dir)
+    # one intra-op XLA thread per replica child: a replica models one
+    # device; unpinned, one XLA CPU process grabs every host core and
+    # 2-replica scaling is flat no matter how good the router is
+    env["XLA_FLAGS"] = ("--xla_cpu_multi_thread_eigen=false "
+                        "intra_op_parallelism_threads=1")
+    cores = os.cpu_count() or 1
+    # bounded sea-state variety (8 distinct design x sea-state pairs):
+    # the warm pass below pays each staging once per owning replica
+    sched_kw = {"designs": FLEET_DESIGNS, "n_hs": 2, "n_tp": 1}
+
+    def run_fleet(tag, n_replicas, measure):
+        # queue_max sized so the full open-loop burst (n_requests in
+        # flight at once at the default rate) is ADMITTED even on one
+        # replica — this block measures throughput, not shedding (the
+        # shed path is proven by fleet-smoke / phase C)
+        cfg = FleetConfig.from_env(
+            replicas=n_replicas, queue_max=max(64, 2 * n_requests),
+            socket_path=os.path.join(tmp, f"fleet_{tag}.sock"))
+        run_dir = os.path.join(tmp, f"run_{tag}")
+        os.makedirs(run_dir, exist_ok=True)
+        fleet = Fleet(cfg, serve_args=serve_args, child_env=env,
+                      run_dir=run_dir)
+        ready = fleet.start()
+        try:
+            with SolveClient(fleet.router.socket_path,
+                             connect_timeout=30.0) as cl:
+                # warm pass: per-replica staging memos hot under the SAME
+                # affinity pins the measured pass will see
+                loadgen.run_open_loop(cl, n_requests, rate, **sched_kw)
+                fleet.router.reset_telemetry()
+                out = measure(cl, fleet)
+            solver = _replica_solver_stats(fleet)
+        finally:
+            fleet.stop()
+        return ready, out, solver
+
+    def counters(fleet):
+        return dict(fleet.router.telemetry()["counters"])
+
+    def leg_summary(open_out, delta):
+        return {
+            "solves_per_s": open_out["solves_per_s"],
+            "latency_p50_s": open_out["latency_p50_s"],
+            "latency_p99_s": open_out["latency_p99_s"],
+            "wall_s": open_out["wall_s"],
+            "relayed": delta["relayed"],
+            "failover": delta["failover"],
+            "shed": delta["shed"],
+        }
+
+    legs: dict = {}
+    warm_ready: dict = {}
+    cold = None
+    step = kill = None
+    for n_rep in replica_counts:
+        if n_rep == 2:
+            def measure(cl, fleet):
+                c0 = counters(fleet)
+                base = loadgen.run_open_loop(cl, n_requests, rate,
+                                             **sched_kw)[0]
+                d_base = _dict_delta(counters(fleet), c0)
+                # ---- load step: below capacity, then 3x capacity ----
+                cap = base["solves_per_s"] or 1.0
+                lo = loadgen.run_open_loop(cl, n_step,
+                                           max(1.0, 0.5 * cap),
+                                           **sched_kw)[0]
+                hi = loadgen.run_open_loop(cl, n_step, 3.0 * cap,
+                                           **sched_kw)[0]
+                # ---- kill leg: counted fault on the first dispatch ----
+                c1 = counters(fleet)
+                faults.reset_counts()
+                os.environ["RAFT_TPU_FAULT_INJECT"] = "kill_replica:1"
+                try:
+                    kl = loadgen.run_open_loop(cl, n_requests, rate,
+                                               **sched_kw)[0]
+                finally:
+                    os.environ.pop("RAFT_TPU_FAULT_INJECT", None)
+                    faults.reset_counts()
+                d_kill = _dict_delta(counters(fleet), c1)
+                return base, d_base, lo, hi, kl, d_kill
+
+            ready, (base, d_base, lo, hi, kl, d_kill), solver = run_fleet(
+                "r2", 2, measure)
+            legs["2"] = leg_summary(base, d_base)
+            step = {
+                "n_requests": n_step,
+                "rate_lo_req_per_s": lo["rate_req_per_s"],
+                "rate_hi_req_per_s": hi["rate_req_per_s"],
+                "p99_lo_s": lo["latency_p99_s"],
+                "p99_hi_s": hi["latency_p99_s"],
+                "p99_ratio": (round(hi["latency_p99_s"]
+                                    / lo["latency_p99_s"], 2)
+                              if lo["latency_p99_s"] else None),
+            }
+            kill = {
+                **leg_summary(kl, d_kill),
+                "all_answered_exactly_once": (
+                    d_kill["relayed"] == n_requests),
+                "restarts": d_kill["restart"],
+                "p99_vs_steady": (round(kl["latency_p99_s"]
+                                        / base["latency_p99_s"], 2)
+                                  if base["latency_p99_s"] else None),
+            }
+        else:
+            def measure(cl, fleet):
+                c0 = counters(fleet)
+                out = loadgen.run_open_loop(cl, n_requests, rate,
+                                            **sched_kw)[0]
+                return out, _dict_delta(counters(fleet), c0)
+
+            ready, (open_out, delta), solver = run_fleet(
+                f"r{n_rep}", n_rep, measure)
+            legs[str(n_rep)] = leg_summary(open_out, delta)
+        if cold is None:
+            # the FIRST fleet is the cold one: its replica pays the
+            # bucket compiles the shared root then amortizes
+            cold = {"compiles": solver[0]["compiles"],
+                    "n_buckets": len(solver[0]["buckets"])}
+        else:
+            warm_ready[str(n_rep)] = [
+                r.get("compiles_at_ready")
+                for r in ready["replicas"].values()]
+    shutil.rmtree(tmp, ignore_errors=True)
+
+    sps = {k: v["solves_per_s"] for k, v in legs.items()}
+    s1 = sps.get("1")
+
+    def scaling(k):
+        return (round(sps[k] / s1, 2)
+                if s1 and sps.get(k) else None)
+
+    return {
+        "mode": "real daemon children, one CPU process per replica "
+                "(a device fleet needs one chip per replica), behind "
+                "the in-process failover router",
+        "nw": nw, "n_iter": n_iter, "batch_max": batch_max,
+        "batch_deadline_ms": deadline_ms,
+        "designs": list(FLEET_DESIGNS),
+        "n_requests": n_requests,
+        "rate_req_per_s": rate,
+        "replicas": legs,
+        "cores": cores,
+        "scaling_2x": scaling("2"),
+        "scaling_4x": scaling("4"),
+        # the acceptance gate: 2 replicas >= 1.7x one replica's
+        # solves/s — assessable only where 2 replicas can actually run
+        # in parallel (null on a < 2-core host, note below)
+        "near_linear_2x": (
+            None if cores < 2 or scaling("2") is None
+            else bool(scaling("2") >= 1.7)),
+        **({"note": f"{cores}-core host: replica processes multiplex "
+                    "one core, so the scaling ratios measure the OS "
+                    "scheduler, not the fleet; the near-linear gate "
+                    "needs >= 2 cores"} if cores < 2 else {}),
+        "cold": cold,
+        # every fleet after the first arms entirely warm off the shared
+        # AOT root: zero compiles at ready, per replica
+        "warm_fleets_zero_compiles": all(
+            all(c == 0 for c in v) for v in warm_ready.values()),
+        "warm_compiles_at_ready": warm_ready,
+        "load_step": step,
+        "kill_leg": kill,
+    }
+
+
+def _dict_delta(after: dict, before: dict) -> dict:
+    return {k: after[k] - before.get(k, 0) for k in after}
+
+
 def _serial_rao(members, rna, wave, env, C_moor, bem=None, nw=200, n_iter=40, tol=0.01):
     """Reference-style serial path: per-node Python-loop drag linearization +
     per-frequency 6x6 solve, same convergence rule (raft/raft.py:1542-1547).
@@ -1321,6 +1544,17 @@ def main():
             sv = serving_block(**({} if not fallback else
                                   {"n_requests": 24, "nw": 16,
                                    "n_iter": 10}))
+        with prof.phase("serving_fleet"):
+            # replica-scaling block: real daemon children behind the
+            # failover router (CPU processes either way — a device
+            # fleet needs one chip per replica); a fleet failure
+            # degrades to a note, never kills the run
+            try:
+                sf = serving_fleet_block(**({} if not fallback else
+                                            {"n_requests": 24,
+                                             "n_step": 16}))
+            except Exception as e:
+                sf = {"error": f"{type(e).__name__}: {str(e)[-300:]}"}
         with prof.phase("bem_block"):
             # novel-geometry BEM staging: native host vs on-device (the
             # jax_bem staging-cliff claim; reduced mesh on CPU fallback)
@@ -1366,6 +1600,7 @@ def main():
                 },
                 "hetero_buckets": hb,
                 "serving": sv,
+                "serving_fleet": sf,
                 "bem": bem,
                 **({"pallas6_microbench": pallas} if pallas else {}),
             },

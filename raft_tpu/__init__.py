@@ -16,4 +16,16 @@ def enable_x64():
     jax.config.update("jax_enable_x64", True)
 
 
-from raft_tpu.core.types import Env, HydroCoeffs, MemberSet, RigidBodyCoeffs, RNA, WaveState  # noqa: F401,E402
+# lazy type re-exports (PEP 562): importing the package must not pay the
+# JAX import — the serving fleet's router/supervisor processes are pure
+# socket plumbing and stay JAX-free (see raft_tpu/serve/router.py)
+_TYPE_EXPORTS = ("Env", "HydroCoeffs", "MemberSet", "RigidBodyCoeffs",
+                 "RNA", "WaveState")
+
+
+def __getattr__(name: str):
+    if name in _TYPE_EXPORTS:
+        from raft_tpu.core import types
+
+        return getattr(types, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

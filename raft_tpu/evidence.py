@@ -9,10 +9,14 @@
    ``lint/budgets.json``, surfaced as the ``lint.budgets`` block),
 3. the serve smoke (``python -m raft_tpu.serve smoke``: the resident
    daemon's cross-process compile-collapse + kill/warm-restart proof),
-4. the multi-chip dry run (``__graft_entry__.dryrun_multichip(8)``) in a
+4. the fleet smoke (``python -m raft_tpu.serve fleet-smoke``: supervised
+   replicas behind the failover router — kill mid-stream with zero
+   lost/duplicated answers and bit-identical rows, warm zero-compile
+   restart, deterministic typed load shedding),
+5. the multi-chip dry run (``__graft_entry__.dryrun_multichip(8)``) in a
    fresh subprocess under the same kind of wall-clock budget the driver
    applies,
-5. ``bench.py`` (device if reachable, labeled CPU fallback otherwise),
+6. ``bench.py`` (device if reachable, labeled CPU fallback otherwise),
 
 and writes ``EVIDENCE.json`` at the repo root with one entry per artifact
 (ok flag, rc, wall-clock, output tail).  Purpose: "passes locally but red
@@ -24,6 +28,7 @@ uses (no shared jax state with the invoking process).
 Knobs (env): ``RAFT_EVIDENCE_SKIP_TESTS=1`` to skip the test tier,
 ``RAFT_EVIDENCE_LINT_TIMEOUT`` (s, default 600),
 ``RAFT_EVIDENCE_DRYRUN_TIMEOUT`` (s, default 300),
+``RAFT_EVIDENCE_FLEET_TIMEOUT`` (s, default 600),
 ``RAFT_EVIDENCE_BENCH_TIMEOUT`` (s, default 1800).
 """
 from __future__ import annotations
@@ -118,6 +123,25 @@ def main():
             continue
     evidence["serve_smoke"] = serve
 
+    print("[evidence] fleet-smoke (replicas + failover router, "
+          "cross-process) ...", flush=True)
+    fleet = _run(
+        [sys.executable, "-m", "raft_tpu.serve", "fleet-smoke"],
+        timeout=float(os.environ.get("RAFT_EVIDENCE_FLEET_TIMEOUT", "600")),
+        label="fleet_smoke",
+    )
+    # the fleet smoke's one JSON line carries the robustness proof
+    # (kill_replica:1 mid-stream -> every request answered exactly once
+    # with bit-identical rows, warm zero-compile restart + re-admission,
+    # deterministic typed shed + recover): one key deep, same as serve
+    for line in reversed(fleet.pop("stdout_tail", [])):
+        try:
+            fleet["json"] = json.loads(line)
+            break
+        except json.JSONDecodeError:
+            continue
+    evidence["fleet_smoke"] = fleet
+
     print("[evidence] dryrun_multichip(8) ...", flush=True)
     evidence["multichip"] = _run(
         [sys.executable, "-c",
@@ -192,6 +216,12 @@ def main():
                 bench["serving_slo"] = sv["slo"]
             if sv.get("ledger") is not None:
                 bench["serving_ledger"] = sv["ledger"]
+        # replica-scaling block (solves/s at 1 vs 2 vs 4 replicas behind
+        # the failover router, load-step p99, kill-leg p99): the fleet
+        # throughput/robustness story one key deep as well
+        sf = bench_json.get("workloads", {}).get("serving_fleet")
+        if sf is not None:
+            bench["serving_fleet"] = sf
     else:
         bench["ok"] = False
         bench["error"] = "no JSON line found on bench stdout"

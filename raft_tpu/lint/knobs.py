@@ -143,6 +143,31 @@ KNOBS: tuple[Knob, ...] = (
          "abstract batch signature)"),
     Knob("RAFT_TPU_SERVE_SOCKET", "per-uid tmp path", "serve.config", HOST,
          "Default AF_UNIX socket path of the solver daemon"),
+    # ------------------------------------------------------ serving fleet ----
+    # Snapshotted ONCE at fleet arm time (FleetConfig.from_env — the
+    # GL303 contract); the router's concurrent request path only ever
+    # sees the frozen snapshot.  All host-side: replica daemons inherit
+    # their own RAFT_TPU_SERVE_* knobs; nothing here touches a traced
+    # program or an AOT key.
+    Knob("RAFT_TPU_FLEET_REPLICAS", "2", "serve.fleet", HOST,
+         "Replica daemon count of the supervised serving fleet"),
+    Knob("RAFT_TPU_FLEET_PROBE_MS", "500 ms", "serve.fleet", HOST,
+         "Heartbeat cadence of the router's replica health probes (and "
+         "the supervisor's babysit sweep)"),
+    Knob("RAFT_TPU_FLEET_PROBE_TIMEOUT_MS", "2000 ms", "serve.fleet", HOST,
+         "Deadline on each ping probe / admission / refresh connection"),
+    Knob("RAFT_TPU_FLEET_QUEUE_MAX", "32", "serve.fleet", HOST,
+         "Per-replica in-flight cap; admission sheds past queue_max x "
+         "healthy replicas"),
+    Knob("RAFT_TPU_FLEET_SHED_ERROR_RATE", "0.5", "serve.fleet", HOST,
+         "Windowed SLO error rate above which admission sheds (typed "
+         "Overloaded responses with a retry-after hint)"),
+    Knob("RAFT_TPU_FLEET_RESTART_MAX", "3", "serve.fleet", HOST,
+         "Restart-storm bound: max restarts per replica per window"),
+    Knob("RAFT_TPU_FLEET_RESTART_WINDOW_S", "30 s", "serve.fleet", HOST,
+         "Sliding window of the restart-storm bound"),
+    Knob("RAFT_TPU_FLEET_SOCKET", "per-uid tmp path", "serve.fleet", HOST,
+         "Front-end AF_UNIX socket path of the fleet router"),
     # ------------------------------------------------- fault injection ----
     Knob("RAFT_TPU_FAULT_INJECT", "unset", "resilience.faults", FAULT,
          "Deterministic host-side fault specs (nan_chunk:K, kill, ...)"),
@@ -205,10 +230,11 @@ def rst_table(names=None) -> str:
 
 
 def serve_knob_names() -> tuple:
-    """The resident-solver-service knobs (the ``docs/serving.rst``
-    autogen subset)."""
+    """The serving-tier knobs — single daemon plus fleet (the
+    ``docs/serving.rst`` autogen subset)."""
     return tuple(k.name for k in KNOBS
-                 if k.name.startswith("RAFT_TPU_SERVE_"))
+                 if k.name.startswith(("RAFT_TPU_SERVE_",
+                                       "RAFT_TPU_FLEET_")))
 
 
 def _docs_path(fname: str) -> str:

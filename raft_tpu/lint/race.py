@@ -284,22 +284,27 @@ def scenario_chunkstore(tmp: str) -> dict:
 
 
 def scenario_fault_counters() -> dict:
-    """``hang_subprocess:K`` fires exactly K times across N threads."""
+    """Every counted fault kind fires exactly K times across N threads —
+    ``hang_subprocess:K`` plus the fleet's replica kinds, armed together
+    in ONE spec so per-kind counters can't bleed into each other under
+    contention (the router consumes kill/stall/refuse from concurrent
+    dispatch and probe threads)."""
     from raft_tpu.resilience import faults
 
     out: dict = {}
-    k_budget = 5
+    budgets = {"hang_subprocess": 5, "kill_replica": 3,
+               "stall_replica": 4, "refuse_connect": 2}
     old = os.environ.get("RAFT_TPU_FAULT_INJECT")
-    os.environ["RAFT_TPU_FAULT_INJECT"] = f"hang_subprocess:{k_budget}"
+    os.environ["RAFT_TPU_FAULT_INJECT"] = ",".join(
+        f"{name}:{k}" for name, k in budgets.items())
     faults.reset_counts()
-    fires = [0] * THREADS
+    fires = [{name: 0 for name in budgets} for _ in range(THREADS)]
 
     def worker(i):
-        n = 0
         for _ in range(200):
-            if faults.consume("hang_subprocess"):
-                n += 1
-        fires[i] = n
+            for name in budgets:
+                if faults.consume(name):
+                    fires[i][name] += 1
 
     try:
         errors = _run_threads(THREADS, worker)
@@ -310,9 +315,11 @@ def scenario_fault_counters() -> dict:
             os.environ["RAFT_TPU_FAULT_INJECT"] = old
         faults.reset_counts()
     _check(out, "no_errors", not errors, "; ".join(errors))
-    _check(out, "exact_fires", sum(fires) == k_budget,
-           f"{sum(fires)} fires != budget {k_budget}")
-    out["fires"] = sum(fires)
+    totals = {name: sum(f[name] for f in fires) for name in budgets}
+    for name, k_budget in budgets.items():
+        _check(out, f"exact_fires_{name}", totals[name] == k_budget,
+               f"{totals[name]} fires != budget {k_budget}")
+    out["fires"] = totals
     return out
 
 

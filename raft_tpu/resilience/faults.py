@@ -29,11 +29,24 @@ injection points, armed by one environment variable:
       their timeout instead of running; with ``:N`` only the first ``N``
       launches in this process hang (so a bounded retry can be seen to
       salvage the call).
+    * ``kill_replica[:K]`` — the serving fleet's router
+      (:mod:`raft_tpu.serve.router`) SIGKILLs the replica it just picked
+      for the next ``K`` dispatches, BEFORE forwarding the request: the
+      replica-death simulation for the failover-resubmission path (the
+      request must still be answered, by a survivor).
+    * ``stall_replica[:K]`` — the router registers the next ``K``
+      forwarded requests but silently withholds the frames (the replica
+      never sees them): the wedged-replica simulation for the
+      forward-deadline / resubmission path.
+    * ``refuse_connect[:K]`` — the router's next ``K`` replica connection
+      attempts raise ``ConnectionRefusedError`` before touching the
+      socket: the crash-during-restart simulation for the bounded
+      reconnect ladder and the re-admission probe.
 
 All injection points are HOST-side (fetch results, file writes,
-subprocess spawns): arming a fault never changes any traced/compiled
-program, so the AOT cache keys and the trace-audit budgets are
-untouched by the harness.
+subprocess spawns, router-side socket plumbing): arming a fault never
+changes any traced/compiled program, so the AOT cache keys and the
+trace-audit budgets are untouched by the harness.
 """
 from __future__ import annotations
 
@@ -45,6 +58,14 @@ import numpy as np
 #: exit code of a ``kill_after_chunk`` hard exit (distinct from common
 #: shells/python codes so the smoke can assert the kill really fired)
 KILL_EXIT = 77
+
+#: every fault kind an armed spec may name (the docstring above is the
+#: contract; a misspelled kind must warn as loudly as a malformed arg —
+#: a harness silently arming nothing "passes" every resilience check)
+KINDS = frozenset({
+    "nan_chunk", "kill_after_chunk", "corrupt_ckpt", "hang_subprocess",
+    "kill_replica", "stall_replica", "refuse_connect",
+})
 
 # per-process consumption counters for counted faults (hang_subprocess:N);
 # locked so ``name:N`` fires exactly N times even under concurrent
@@ -69,6 +90,14 @@ def specs() -> dict:
         if not part:
             continue
         name, _, arg = part.partition(":")
+        if name not in KINDS:
+            import warnings
+
+            warnings.warn(
+                f"RAFT_TPU_FAULT_INJECT spec {part!r} names an unknown "
+                f"fault kind (have {sorted(KINDS)}); ignoring it",
+                stacklevel=2)
+            continue
         if arg:
             try:
                 arg_i = int(arg)

@@ -37,7 +37,17 @@ The moving parts, one module each:
     loop draining the batcher, graceful SIGTERM drain.
 ``client``
     :class:`~raft_tpu.serve.client.SolveClient` — async submit/collect
-    over the socket (futures keyed by request id).
+    over the socket (futures keyed by request id), typed transport
+    failures (``ServeTimeout`` / ``ServeConnectionLost``), bounded
+    reconnects through the shared retry ladder.
+``fleet`` / ``router``
+    The horizontally scaled tier: :class:`~raft_tpu.serve.fleet.Fleet`
+    supervises N daemon replicas on one shared AOT cache root (warm,
+    zero-compile restarts; storm-bounded) behind a
+    :class:`~raft_tpu.serve.router.FleetRouter` — same wire protocol,
+    one socket, bucket-affinity routing, heartbeat health probes,
+    failover resubmission of in-flight requests, and error-budget load
+    shedding with typed ``Overloaded`` responses (``make fleet-smoke``).
 ``loadgen``
     Synthetic OPEN-LOOP load generator with a closed-form arrival
     schedule (zero wall-clock randomness) and deterministic p50/p99
@@ -54,8 +64,30 @@ vmapped axis whose per-lane program is independent — the same request
 returns bit-identical results whether it shared its batch with zero,
 three, or seven strangers (pinned by tests/test_serve.py).
 """
-from raft_tpu.serve.config import ServeConfig                     # noqa: F401
-from raft_tpu.serve.batcher import Lane, MicroBatcher             # noqa: F401
-from raft_tpu.serve.solver import SolverCore, solve_batch         # noqa: F401
-from raft_tpu.serve.client import SolveClient                     # noqa: F401
-from raft_tpu.serve.server import SolverServer                    # noqa: F401
+# lazy exports (PEP 562): the fleet tier (router/supervisor/smoke
+# parents) imports serve submodules without paying — or even having —
+# the solver stack's JAX import; attribute access resolves on demand
+_EXPORTS = {
+    "ServeConfig": "raft_tpu.serve.config",
+    "Lane": "raft_tpu.serve.batcher",
+    "MicroBatcher": "raft_tpu.serve.batcher",
+    "SolverCore": "raft_tpu.serve.solver",
+    "solve_batch": "raft_tpu.serve.solver",
+    "SolveClient": "raft_tpu.serve.client",
+    "SolverServer": "raft_tpu.serve.server",
+    "Fleet": "raft_tpu.serve.fleet",
+    "FleetConfig": "raft_tpu.serve.fleet",
+    "FleetRouter": "raft_tpu.serve.router",
+}
+
+__all__ = sorted(_EXPORTS)
+
+
+def __getattr__(name: str):
+    target = _EXPORTS.get(name)
+    if target is None:
+        raise AttributeError(f"module {__name__!r} has no attribute "
+                             f"{name!r}")
+    import importlib
+
+    return getattr(importlib.import_module(target), name)

@@ -9,6 +9,16 @@
 ``python -m raft_tpu.serve smoke``
     The cross-process proof (``make serve-smoke``); see
     :mod:`raft_tpu.serve.smoke`.
+
+``python -m raft_tpu.serve fleet [flags]``
+    Run the supervised replica fleet in the foreground: N warm daemon
+    children on one shared cache root behind the failover router, one
+    ``{"ready": true, ...}`` JSON line, serve until SIGTERM/SIGINT.
+    The ``RAFT_TPU_FLEET_*`` knobs govern; flags override.
+
+``python -m raft_tpu.serve fleet-smoke``
+    The fleet robustness proof (``make fleet-smoke``); see
+    :mod:`raft_tpu.serve.fleet_smoke`.
 """
 from __future__ import annotations
 
@@ -84,12 +94,81 @@ def _daemon(argv) -> int:
     return 0
 
 
+def _fleet(argv) -> int:
+    t0 = time.perf_counter()
+    p = argparse.ArgumentParser(prog="raft_tpu.serve fleet")
+    p.add_argument("--replicas", type=int, default=None,
+                   help="replica count (default: RAFT_TPU_FLEET_REPLICAS)")
+    p.add_argument("--socket", default=None,
+                   help="front-end AF_UNIX socket path (default: "
+                        "RAFT_TPU_FLEET_SOCKET or the per-uid tmp path)")
+    p.add_argument("--nw", type=int, default=100, help="frequency bins")
+    p.add_argument("--n-iter", type=int, default=25,
+                   help="fixed-point iterations per solve")
+    p.add_argument("--deadline-ms", type=float, default=None,
+                   help="per-replica RAFT_TPU_SERVE_BATCH_DEADLINE_MS")
+    p.add_argument("--batch-max", type=int, default=None,
+                   help="per-replica RAFT_TPU_SERVE_BATCH_MAX")
+    p.add_argument("--warm", default=None,
+                   help="comma-separated designs every replica pre-arms")
+    args = p.parse_args(argv)
+
+    from raft_tpu.serve.fleet import Fleet, FleetConfig
+
+    overrides: dict = {}
+    if args.replicas is not None:
+        overrides["replicas"] = args.replicas
+    if args.socket is not None:
+        overrides["socket_path"] = args.socket
+    cfg = FleetConfig.from_env(**overrides)
+    serve_args = ["--nw", str(args.nw), "--n-iter", str(args.n_iter)]
+    if args.deadline_ms is not None:
+        serve_args += ["--deadline-ms", str(args.deadline_ms)]
+    if args.batch_max is not None:
+        serve_args += ["--batch-max", str(args.batch_max)]
+    if args.warm:
+        serve_args += ["--warm", args.warm]
+    fleet = Fleet(cfg, serve_args=serve_args)
+
+    stopped = threading.Event()
+
+    def _term(_sig, _frm):
+        # stop() blocks on child SIGTERM drains — never in a signal frame
+        def _run():
+            fleet.stop()
+            stopped.set()
+
+        threading.Thread(target=_run, name="fleet-sigterm",
+                         daemon=True).start()
+
+    signal.signal(signal.SIGTERM, _term)
+    signal.signal(signal.SIGINT, _term)
+
+    ready = fleet.start()
+    print(json.dumps({
+        "ready": True,
+        "socket": ready["socket"],
+        "replicas": ready["replicas"],
+        "ready_s": round(time.perf_counter() - t0, 3),
+    }), flush=True)
+    stopped.wait()
+    print(json.dumps({"exit": True,
+                      "telemetry": fleet.telemetry()}), flush=True)
+    return 0
+
+
 def main(argv=None) -> int:
     argv = sys.argv[1:] if argv is None else argv
     if argv and argv[0] == "smoke":
         from raft_tpu.serve import smoke
 
         return smoke.main(argv[1:])
+    if argv and argv[0] == "fleet-smoke":
+        from raft_tpu.serve import fleet_smoke
+
+        return fleet_smoke.main(argv[1:])
+    if argv and argv[0] == "fleet":
+        return _fleet(argv[1:])
     if argv and argv[0] == "daemon":
         argv = argv[1:]
     return _daemon(argv)

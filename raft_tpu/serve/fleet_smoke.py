@@ -1,0 +1,263 @@
+"""Fleet-smoke: cross-process proof of the fault-tolerant serving tier.
+
+``python -m raft_tpu.serve fleet-smoke`` (``make fleet-smoke``, CI fast
+job) runs the REAL fleet — supervisor + router in this process (both
+JAX-free), real daemon children over real sockets — and proves the
+robustness contract in three phases on ONE shared AOT cache root:
+
+* **Phase A (reference)**: a cold 1-replica fleet serves the mixed
+  3-design stream through the router; rows become the bit-identical
+  reference, and the single replica pays exactly ``n_buckets`` compiles.
+* **Phase B (failover)**: a 2-replica fleet arms entirely warm (both
+  replicas ZERO compiles at ready, off the shared root).  Mid-stream,
+  the counted ``kill_replica:1`` fault SIGKILLs the replica the router
+  just picked — every request is still answered exactly once (zero
+  lost: all futures resolve ok; zero duplicate: the router relays
+  exactly one response per request), rows are bit-identical to Phase A,
+  at least one response carries a ``resubmits`` count, the survivors
+  pay zero compiles, and the supervisor restarts the dead replica warm
+  (zero compiles at ready) with the router re-admitting it only after a
+  passing probe.
+* **Phase C (shed-then-recover)**: a 1-replica fleet with ``queue_max=1``
+  and a short forward deadline; ``stall_replica:1`` wedges the first
+  request in flight, so a burst of 7 more is deterministically shed with
+  typed ``Overloaded`` responses carrying ``retry_after_ms`` hints.  The
+  stalled request is recovered by the forward deadline (answered, with a
+  resubmit), and every shed request succeeds on sequential re-submission
+  — load shedding degrades, never loses.
+
+Prints one JSON line; rc 0 iff all checks hold.
+"""
+from __future__ import annotations
+
+import json
+import os
+import sys
+import tempfile
+import time
+
+from raft_tpu.resilience import faults
+from raft_tpu.serve.smoke import (BATCH_MAX, DEADLINE_MS, N_ITER, NW,
+                                  _child_env)
+
+#: the mixed stream: 3 designs x 4 rounds = 12 solve requests, landing
+#: in the stock ladder's buckets (the serve-smoke stream, one round up —
+#: the kill fires mid-stream with work on both sides of it)
+STREAM = [(d, 6.0 + 0.5 * (i % 3), 10.0 + 0.5 * (i % 2))
+          for i, d in enumerate(["oc3", "oc4", "volturnus"] * 4)]
+
+SERVE_ARGS = ["--nw", str(NW), "--n-iter", str(N_ITER),
+              "--deadline-ms", str(DEADLINE_MS),
+              "--batch-max", str(BATCH_MAX),
+              "--warm", "oc3,oc4,volturnus"]
+
+
+def _fleet_env(cache_dir: str) -> dict:
+    """Replica child environment: shared cache root, CPU platform, no
+    inherited fault arming (the parent arms faults for the ROUTER; a
+    child inheriting them would double-fire)."""
+    env = _child_env(cache_dir)
+    env.pop("RAFT_TPU_FAULT_INJECT", None)
+    return env
+
+
+def _mk_fleet(cache_dir: str, tmp: str, tag: str, **cfg_overrides):
+    from raft_tpu.serve.fleet import Fleet, FleetConfig
+
+    cfg = FleetConfig.from_env(
+        socket_path=os.path.join(tmp, f"fleet-{tag}.sock"),
+        **cfg_overrides)
+    run_dir = os.path.join(tmp, f"run-{tag}")
+    os.makedirs(run_dir, exist_ok=True)
+    return Fleet(cfg, serve_args=SERVE_ARGS, child_env=_fleet_env(cache_dir),
+                 run_dir=run_dir)
+
+
+def _counters(fleet) -> dict:
+    return dict(fleet.router.telemetry()["counters"])
+
+
+def _delta(after: dict, before: dict) -> dict:
+    return {k: after[k] - before.get(k, 0) for k in after}
+
+
+def _drive(sock: str, arm_kill_after: int | None = None):
+    """Submit the stream open-loop through the router; optionally arm
+    ``kill_replica:1`` after the first ``arm_kill_after`` responses have
+    landed (so the kill strikes mid-stream, deterministically between
+    two requests).  Returns (rows, responses)."""
+    from raft_tpu.serve.client import SolveClient
+
+    with SolveClient(sock, connect_timeout=30.0) as cl:
+        head = STREAM if arm_kill_after is None else STREAM[:arm_kill_after]
+        tail = [] if arm_kill_after is None else STREAM[arm_kill_after:]
+        futs = [cl.submit({"op": "solve", "design": d, "Hs": Hs, "Tp": Tp})
+                for d, Hs, Tp in head]
+        resps = [f.result(180.0) for f in futs]
+        if tail:
+            faults.reset_counts()
+            os.environ["RAFT_TPU_FAULT_INJECT"] = "kill_replica:1"
+            try:
+                futs = [cl.submit({"op": "solve", "design": d,
+                                   "Hs": Hs, "Tp": Tp})
+                        for d, Hs, Tp in tail]
+                resps += [f.result(180.0) for f in futs]
+            finally:
+                os.environ.pop("RAFT_TPU_FAULT_INJECT", None)
+                faults.reset_counts()
+    bad = [r for r in resps if not r.get("ok")]
+    if bad:
+        raise RuntimeError(f"{len(bad)} requests failed: {bad[0]}")
+    rows = [r["results"][0]["std_dev"] for r in resps]
+    return rows, resps
+
+
+def _replica_solver_stats(fleet) -> list:
+    """Per-replica ``stats`` over a direct connection to each replica
+    socket (compile counts are per-process truths the router can't
+    fake)."""
+    from raft_tpu.serve.client import SolveClient
+
+    out = []
+    for rep in fleet.telemetry()["supervisor"]["replicas"]:
+        with SolveClient(rep["socket"], connect_timeout=10.0) as cl:
+            out.append(cl.stats()["solver"])
+    return out
+
+
+def _wait_healthy(fleet, n: int, timeout_s: float = 120.0) -> bool:
+    t0 = time.monotonic()
+    while time.monotonic() - t0 < timeout_s:
+        if fleet.router.telemetry()["healthy"] >= n:
+            return True
+        time.sleep(0.25)
+    return False
+
+
+def main(argv=None) -> int:
+    t_all = time.perf_counter()
+    keep = argv and "--keep" in argv
+    tmp = tempfile.mkdtemp(prefix="raft_tpu_fleet_smoke_")
+    cache_dir = os.path.join(tmp, "cache")
+    checks: dict = {}
+    info: dict = {}
+    os.environ.pop("RAFT_TPU_FAULT_INJECT", None)
+    faults.reset_counts()
+    try:
+        # ---- Phase A: cold 1-replica reference through the router ----
+        fleet = _mk_fleet(cache_dir, tmp, "a", replicas=1)
+        fleet.start()
+        c0 = _counters(fleet)
+        rows_ref, _ = _drive(fleet.router.socket_path)
+        d = _delta(_counters(fleet), c0)
+        solver_a = _replica_solver_stats(fleet)[0]
+        n_buckets = len(solver_a["buckets"])
+        fleet.stop()
+        checks["cold_compiles_eq_buckets"] = (
+            solver_a["compiles"] == n_buckets > 0)
+        checks["phase_a_all_relayed"] = (
+            d["relayed"] == len(STREAM) and d["failover"] == 0)
+        info["n_buckets"] = n_buckets
+        info["cold_compiles"] = solver_a["compiles"]
+
+        # ---- Phase B: 2 replicas warm; kill one mid-stream ----
+        fleet = _mk_fleet(cache_dir, tmp, "b", replicas=2)
+        ready = fleet.start()
+        warm_ready = [r.get("compiles_at_ready")
+                      for r in ready["replicas"].values()]
+        checks["warm_fleet_zero_compiles_at_ready"] = warm_ready == [0, 0]
+        c0 = _counters(fleet)
+        rows_b, resps_b = _drive(fleet.router.socket_path,
+                                 arm_kill_after=4)
+        d = _delta(_counters(fleet), c0)
+        resubmitted = [r for r in resps_b if r.get("resubmits")]
+        checks["kill_all_answered_exactly_once"] = (
+            len(resps_b) == len(STREAM)
+            and all(r.get("ok") for r in resps_b)
+            and d["relayed"] == len(STREAM))
+        checks["kill_failover_fired"] = (
+            d["failover"] >= 1 and len(resubmitted) >= 1)
+        checks["kill_rows_bit_identical"] = rows_b == rows_ref
+        restarted = _wait_healthy(fleet, 2)
+        checks["dead_replica_restarted_and_readmitted"] = restarted
+        sup = fleet.telemetry()["supervisor"]["replicas"]
+        restarts = {r["idx"]: fleet._replicas[r["idx"]].restarts
+                    for r in sup}
+        killed = [i for i, n in restarts.items() if n > 0]
+        checks["restart_counter_fired"] = (
+            _counters(fleet)["restart"] - c0["restart"] >= 1
+            and len(killed) == 1)
+        checks["restarted_replica_warm"] = all(
+            fleet._replicas[i].ready.get("compiles_at_ready") == 0
+            for i in killed)
+        solver_b = _replica_solver_stats(fleet) if restarted else []
+        checks["survivors_and_restart_zero_compiles"] = (
+            bool(solver_b) and all(s["compiles"] == 0 for s in solver_b))
+        fleet.stop()
+        info["failover_requests"] = d["failover"]
+        info["resubmitted_responses"] = len(resubmitted)
+        info["killed_replica"] = killed
+
+        # ---- Phase C: forced overload -> typed shed -> recover ----
+        fleet = _mk_fleet(cache_dir, tmp, "c", replicas=1, queue_max=1,
+                          request_timeout_s=2.0)
+        fleet.start()
+        c0 = _counters(fleet)
+        from raft_tpu.serve.client import SolveClient
+
+        with SolveClient(fleet.router.socket_path,
+                         connect_timeout=30.0) as cl:
+            faults.reset_counts()
+            os.environ["RAFT_TPU_FAULT_INJECT"] = "stall_replica:1"
+            try:
+                stalled = cl.submit({"op": "solve", "design": "oc3",
+                                     "Hs": 6.0, "Tp": 10.0})
+                burst = [cl.submit({"op": "solve", "design": d,
+                                    "Hs": Hs, "Tp": Tp})
+                         for d, Hs, Tp in STREAM[1:8]]
+                shed = [f.result(30.0) for f in burst]
+            finally:
+                os.environ.pop("RAFT_TPU_FAULT_INJECT", None)
+                faults.reset_counts()
+            checks["overload_sheds_typed"] = all(
+                r.get("ok") is False and r.get("shed") is True
+                and r.get("error", {}).get("class") == "Overloaded"
+                and r.get("retry_after_ms", 0) > 0 for r in shed)
+            # the stalled request is recovered by the forward deadline
+            stalled_resp = stalled.result(60.0)
+            checks["stalled_request_recovered"] = (
+                stalled_resp.get("ok") is True
+                and stalled_resp.get("resubmits", 0) >= 1)
+            # shed-then-recover: every shed request succeeds re-submitted
+            redo = [cl.call({"op": "solve", "design": d,
+                             "Hs": Hs, "Tp": Tp}, timeout=60.0)
+                    for d, Hs, Tp in STREAM[1:8]]
+            checks["shed_requests_recover"] = all(
+                r.get("ok") for r in redo)
+        d = _delta(_counters(fleet), c0)
+        # exactly the 7 burst requests shed (dispatch is sequential on
+        # the conn reader, so admission sees each one's predecessor)
+        checks["shed_counter_deterministic"] = d["shed"] == 7
+        checks["forward_deadline_counter_fired"] = d["timeouts"] >= 1
+        fleet.stop()
+        info["shed_count"] = d["shed"]
+
+        ok = all(checks.values())
+        print(json.dumps({
+            "ok": ok, **checks, **info,
+            "n_requests": len(STREAM),
+            "wall_s": round(time.perf_counter() - t_all, 2),
+            **({"dir": tmp} if keep else {}),
+        }))
+        return 0 if ok else 1
+    finally:
+        os.environ.pop("RAFT_TPU_FAULT_INJECT", None)
+        faults.reset_counts()
+        if not keep:
+            import shutil
+
+            shutil.rmtree(tmp, ignore_errors=True)
+
+
+if __name__ == "__main__":                                # pragma: no cover
+    sys.exit(main())

@@ -37,6 +37,14 @@ request (``dlc``/``sweep``) answers once, after its last lane's batch
 lands.  Errors: ``{"id": ..., "ok": false, "error": {"class": ...,
 "detail": ...}}``.
 
+Load shedding (the fleet router, :mod:`raft_tpu.serve.router`): a
+request refused by admission control answers immediately with the typed
+``overloaded`` error — ``{"id": ..., "ok": false, "shed": true,
+"retry_after_ms": <hint>, "error": {"class": "Overloaded", "detail":
+...}}``.  Solves are pure, so a shed request is safe to re-submit after
+the hint; the single-daemon server never sheds (its micro-batch queue is
+its own backpressure).
+
 The ``stats`` op answers with the live telemetry snapshot::
 
     {"id": ..., "ok": true, "op": "stats",
@@ -89,6 +97,13 @@ class ProtocolError(ValueError):
 
 class PeerClosed(ConnectionError):
     """The peer closed the stream mid-frame (or before one started)."""
+
+
+class Overloaded(RuntimeError):
+    """Typed load-shed signal: the fleet refused admission (capacity or
+    error budget).  Carried on the wire as ``error.class == "Overloaded"``
+    plus a top-level ``retry_after_ms`` hint — solves are pure, so the
+    client may simply re-submit after the hint."""
 
 
 def send_msg(sock, obj) -> None:
@@ -211,3 +226,14 @@ def error_response(req_id, exc) -> dict:
     return {"id": req_id, "ok": False,
             "error": {"class": type(exc).__name__,
                       "detail": str(exc)[-500:]}}
+
+
+def overloaded_response(req_id, retry_after_ms: float,
+                        detail: str = "") -> dict:
+    """The typed shed response (see the module docstring): an
+    ``Overloaded`` error frame with a ``retry_after_ms`` hint."""
+    return {"id": req_id, "ok": False, "shed": True,
+            "retry_after_ms": round(float(retry_after_ms), 3),
+            "error": {"class": "Overloaded",
+                      "detail": detail or "fleet admission refused; "
+                                          "retry after the hint"}}

@@ -27,10 +27,30 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+import atexit  # noqa: E402
+import shutil  # noqa: E402
+import tempfile  # noqa: E402
+
 import jax  # noqa: E402
 
 jax.config.update("jax_platforms", "cpu")
 jax.config.update("jax_enable_x64", True)
+
+# XLA persistent compilation cache, scoped to THIS run (fresh tmp dir,
+# removed at exit — no cross-run state): the module-boundary
+# jax.clear_caches() below drops live executables to keep XLA-CPU's JIT
+# stable, which otherwise forces full recompiles of the same programs
+# module after module (test_solve / test_cache / test_serve / ... all
+# compile the same entry points).  With the disk cache armed those
+# recompiles become cheap deserializations.  Subprocess-spawning tests
+# are unaffected (config does not propagate through the environment),
+# and the repo's own compile counters count jit/lower calls, not XLA
+# cache misses, so compile-count pins are unchanged.
+_xla_cache_dir = tempfile.mkdtemp(prefix="raft-test-xla-cache-")
+atexit.register(shutil.rmtree, _xla_cache_dir, ignore_errors=True)
+jax.config.update("jax_compilation_cache_dir", _xla_cache_dir)
+jax.config.update("jax_persistent_cache_min_compile_time_secs", 0.0)
+jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
 
 
 _last_module = [None]
